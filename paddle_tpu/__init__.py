@@ -1,0 +1,49 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle 2.x's
+API surface, built on JAX/XLA (compute), Pallas (kernels), and a C++ native
+runtime (data pipeline).
+
+Reference for API parity: /root/reference python/paddle/__init__.py (v2.1).
+"""
+__version__ = '0.1.0'
+
+from .core.dtype import (  # noqa: F401
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128)
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.tensor import no_grad_ctx as no_grad  # noqa: F401
+from .core.tensor import enable_grad_ctx as enable_grad  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import fft  # noqa: F401
+from .tensor.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .tensor import linalg  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import metric  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from .device import set_device, get_device, CPUPlace, TPUPlace, CUDAPlace  # noqa: F401
+from .framework_io import save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary, flops, callbacks  # noqa: F401
+from .batch import batch  # noqa: F401
+from .nn.layer_base import ParamAttr  # noqa: F401
+from .utils.misc import disable_static, enable_static, in_dynamic_mode, grad  # noqa: F401
+
+# Subpackages imported lazily to keep import light:
+#   paddle_tpu.distributed, paddle_tpu.vision, paddle_tpu.text,
+#   paddle_tpu.distribution, paddle_tpu.inference, paddle_tpu.models
+
+
+def __getattr__(name):
+    import importlib
+    if name in ('distributed', 'vision', 'text', 'distribution', 'inference',
+                'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler'):
+        return importlib.import_module(f'.{name}', __name__)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

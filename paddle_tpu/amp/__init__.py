@@ -1,0 +1,155 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py, grad_scaler.py. TPU-native: the
+low-precision dtype defaults to bfloat16 (MXU-native), which needs no loss
+scaling; GradScaler is kept API-compatible and becomes a near-no-op for bf16
+while implementing real dynamic scaling for float16.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+_WHITE = {'linear', 'matmul', 'mm', 'bmm', 'conv1d', 'conv2d', 'conv3d',
+          'conv1d_transpose', 'conv2d_transpose', 'conv3d_transpose', 'einsum_fn'}
+_BLACK = {'softmax', 'log_softmax', 'cross_entropy', 'layer_norm', 'mean', 'sum',
+          'exp', 'log', 'softmax_with_cross_entropy'}
+
+_state = {'enable': False, 'level': 'O1', 'dtype': jnp.bfloat16}
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level='O1', dtype='bfloat16'):
+    prev = dict(_state)
+    _state['enable'] = enable
+    _state['level'] = level
+    _state['dtype'] = jnp.bfloat16 if dtype == 'bfloat16' else jnp.float16
+    if custom_white_list:
+        _state['white_extra'] = set(custom_white_list)
+    if custom_black_list:
+        _state['black_extra'] = set(custom_black_list)
+    try:
+        yield
+    finally:
+        _state.clear()
+        _state.update(prev)
+
+
+autocast = auto_cast
+
+
+def _maybe_cast_args(fn_name, args):
+    if not _state['enable']:
+        return args
+    lp = _state['dtype']
+    white = _WHITE | _state.get('white_extra', set())
+    black = _BLACK | _state.get('black_extra', set())
+    if _state['level'] == 'O2':
+        do_cast = fn_name not in black
+    else:
+        do_cast = fn_name in white
+    if not do_cast:
+        return args
+
+    def cast(a):
+        if hasattr(a, 'dtype') and a.dtype == jnp.float32:
+            return a.astype(lp)
+        return a
+    return [cast(a) if not isinstance(a, (list, tuple)) else
+            type(a)(cast(x) for x in a) for a in args]
+
+
+dispatch.amp_cast_hook = _maybe_cast_args
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2. ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = init_loss_scaling if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameters:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                p.grad._replace_value(g)
+                if not bool(jnp.all(jnp.isfinite(g))):
+                    found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+
+def decorate(models, optimizers=None, level='O2', dtype='bfloat16',
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low-precision dtype (bf16 on TPU)."""
+    lp = 'bfloat16' if dtype == 'bfloat16' else 'float16'
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == 'O2':
+        for m in ms:
+            m.to(dtype=lp)
+    if optimizers is None:
+        return models if single else ms
+    return (models, optimizers)

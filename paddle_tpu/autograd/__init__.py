@@ -1,0 +1,95 @@
+"""Autograd utilities. Reference: python/paddle/autograd + fluid dygraph
+``paddle.grad`` (python/paddle/fluid/dygraph/base.py:grad)."""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, no_grad_ctx as no_grad, enable_grad_ctx as enable_grad  # noqa: F401
+from ..core.tensor import run_backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: returns grads of outputs w.r.t. inputs without touching
+    ``.grad`` of unrelated leaves (we snapshot/restore)."""
+    outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    snap = [(t, t.grad) for t in ins]
+    prev_sg = [t.stop_gradient for t in ins]
+    for t in ins:
+        t.grad = None
+        t._retain = True
+    gts = grad_outputs if grad_outputs is not None else [None] * len(outs)
+    if isinstance(gts, Tensor):
+        gts = [gts]
+    for o, g in zip(outs, gts):
+        run_backward(o, g, retain_graph=True if retain_graph is None else retain_graph)
+    result = []
+    for t in ins:
+        g = t.grad
+        if g is None and not allow_unused:
+            g = Tensor(jnp.zeros(t.shape, t.dtype))
+        result.append(g)
+    for (t, old), sg in zip(snap, prev_sg):
+        t.grad = old
+        t.stop_gradient = sg
+    return result
+
+
+class PyLayer:
+    """Custom autograd op: subclass with static forward(ctx, ...) / backward(ctx, *grads).
+
+    Reference: python/paddle/autograd/py_layer.py.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.dispatch import apply_op
+
+        class _Ctx:
+            def save_for_backward(self, *ts):
+                self.saved = ts
+
+            @property
+            def saved_tensor(self):
+                return self.saved
+
+        ctx = _Ctx()
+        out = cls.forward(ctx, *args, **kwargs)
+        # Route through jax.custom_vjp for grad support
+        tensors = [a for a in args if isinstance(a, Tensor)]
+
+        @jax.custom_vjp
+        def f(*vals):
+            return out._value if isinstance(out, Tensor) else out
+
+        def f_fwd(*vals):
+            return f(*vals), None
+
+        def f_bwd(res, g):
+            gs = cls.backward(ctx, Tensor(g))
+            if isinstance(gs, Tensor):
+                gs = (gs,)
+            return tuple(x._value if isinstance(x, Tensor) else x for x in gs)
+
+        f.defvjp(f_fwd, f_bwd)
+        return apply_op(f, *tensors)
+
+
+def set_grad_enabled(mode):
+    from ..core import tensor as _t
+    _t._state.grad_enabled = bool(mode)
+
+
+def is_grad_enabled():
+    from ..core.tensor import _grad_enabled
+    return _grad_enabled()

@@ -1,0 +1,141 @@
+"""Op dispatch: run a pure jnp/lax function eagerly, recording a vjp tape node
+when any input requires grad.
+
+Design: every public op body is a *pure* function over jax arrays. Eagerly we
+unwrap Tensors, call (optionally through jax.vjp for autograd), and wrap
+results. Under jax.jit tracing the same pure functions run on tracers, so the
+whole op library doubles as the static-graph lowering (reference's analogue:
+fluid op kernels + grad-op registry, paddle/fluid/framework/op_registry.h).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, TapeNode, _grad_enabled
+from . import dtype as dtypes
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_diff_tensor(x):
+    return (isinstance(x, Tensor) and not x.stop_gradient
+            and jnp.issubdtype(x.dtype, jnp.inexact))
+
+
+def _map_structure(fn, obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(fn, o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _map_structure(fn, v) for k, v in obj.items()}
+    return fn(obj)
+
+
+def apply_op(pure_fn, *args, **kwargs):
+    """Execute pure_fn on unwrapped args; record tape if needed.
+
+    Tensor leaves may appear at top level of args or one level inside
+    list/tuple args (e.g. concat([t1, t2])).
+    """
+    diff = []           # list of (path, Tensor)
+
+    def scan(obj, path):
+        if _is_diff_tensor(obj):
+            diff.append((path, obj))
+        elif isinstance(obj, (list, tuple)):
+            for i, o in enumerate(obj):
+                scan(o, path + (i,))
+
+    if _grad_enabled():
+        for i, a in enumerate(args):
+            scan(a, (i,))
+
+    if not diff:
+        out = pure_fn(*_map_structure(_unwrap, list(args)), **kwargs)
+        res = _wrap_outputs(out, node=None)
+        _maybe_record_replay(pure_fn, args, kwargs, res)
+        return res
+
+    paths = [p for p, _ in diff]
+    diff_tensors = [t for _, t in diff]
+
+    def substitute(vals):
+        new_args = list(_map_structure(_unwrap, list(args)))
+        for path, v in zip(paths, vals):
+            if len(path) == 1:
+                new_args[path[0]] = v
+            else:
+                seq = list(new_args[path[0]])
+                seq[path[1]] = v
+                new_args[path[0]] = seq
+        return new_args
+
+    def pure_on_diff(vals):
+        return pure_fn(*substitute(vals), **kwargs)
+
+    primals = [t._value for t in diff_tensors]
+    out, vjp_fn = jax.vjp(pure_on_diff, primals)
+
+    flat_out, is_seq = (list(out), True) if isinstance(out, (list, tuple)) else ([out], False)
+    out_tensors = [Tensor(o, stop_gradient=False) for o in flat_out]
+    if is_seq:
+        container = type(out)
+        node_vjp = lambda cots: vjp_fn(container(cots))[0]
+    else:
+        node_vjp = lambda cots: vjp_fn(cots[0])[0]
+    node = TapeNode(node_vjp, diff_tensors, out_tensors)
+    for i, t in enumerate(out_tensors):
+        t._node = node
+        t._out_idx = i
+    if is_seq:
+        res = type(out)(out_tensors) if isinstance(out, tuple) else out_tensors
+    else:
+        res = out_tensors[0]
+    _maybe_record_replay(pure_fn, args, kwargs, res)
+    return res
+
+
+def _maybe_record_replay(pure_fn, args, kwargs, res):
+    """In static-graph mode, stamp outputs with enough info to recompute them
+    from fed placeholders — this is the Program that static.Executor replays
+    (and jit-compiles). Reference analogue: ops appended to ProgramDesc."""
+    from ..utils import misc
+    if not misc.in_static_mode():
+        return
+    outs = res if isinstance(res, (list, tuple)) else [res]
+    for i, t in enumerate(outs):
+        if isinstance(t, Tensor):
+            t._replay = (pure_fn, args, kwargs, i, isinstance(res, (list, tuple)))
+
+
+def _wrap_outputs(out, node):
+    if isinstance(out, (list, tuple)):
+        return type(out)(Tensor(o) if not isinstance(o, Tensor) else o for o in out)
+    return Tensor(out)
+
+
+# amp/__init__.py installs a hook here that bf16-casts white-listed op inputs.
+amp_cast_hook = None
+
+
+def op(pure_fn):
+    """Decorator: expose a pure jnp function as an eager+autograd op."""
+    name = pure_fn.__name__
+
+    @functools.wraps(pure_fn)
+    def wrapper(*args, **kwargs):
+        kwargs.pop('name', None)
+        if amp_cast_hook is not None:
+            args = amp_cast_hook(name, list(args))
+        return apply_op(pure_fn, *args, **kwargs)
+    wrapper.pure = pure_fn
+    return wrapper
+
+
+def elementwise_op(name, fn, *tensors, **kwargs):
+    """Helper to apply an inline lambda as an op."""
+    return apply_op(fn, *tensors, **kwargs)

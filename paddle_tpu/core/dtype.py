@@ -1,0 +1,43 @@
+"""Dtype aliases with Paddle's names, backed by JAX dtypes.
+
+Reference: python/paddle/framework/dtype.py (paddle.float32 etc.).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    'bool': bool, 'uint8': uint8, 'int8': int8, 'int16': int16,
+    'int32': int32, 'int64': int64, 'float16': float16,
+    'bfloat16': bfloat16, 'float32': float32, 'float64': float64,
+    'complex64': complex64, 'complex128': complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a string / numpy / jax dtype spec to a numpy dtype-like."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _STR2DTYPE[dtype]
+    return np.dtype(dtype).type if not hasattr(dtype, 'dtype') else dtype
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name if np.dtype(dtype).name != 'bool_' else 'bool'
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.inexact)

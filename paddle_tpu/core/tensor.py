@@ -1,0 +1,286 @@
+"""Eager Tensor with tape-based autograd over jax.vjp.
+
+This is the TPU-native analogue of Paddle's dygraph VarBase
+(reference: paddle/fluid/imperative/layer.h, python/paddle/fluid/dygraph/varbase_patch_methods.py).
+Instead of a C++ grad-op graph, every differentiable op call records a
+``jax.vjp`` closure; ``Tensor.backward()`` replays them in reverse creation
+order. Ops themselves are pure jnp/lax functions, so the same op library is
+reused verbatim under ``jax.jit`` tracing for the static/compiled path.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+_state = threading.local()
+
+
+def _grad_enabled():
+    return getattr(_state, 'grad_enabled', True)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    prev = _grad_enabled()
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_ctx():
+    prev = _grad_enabled()
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+class TapeNode:
+    """One recorded differentiable op: vjp closure + input/output bookkeeping."""
+
+    __slots__ = ('vjp_fn', 'inputs', 'out_specs', 'out_refs', 'index', '__weakref__')
+    _counter = 0
+
+    def __init__(self, vjp_fn, inputs, outputs):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs              # list[Tensor] (the diff inputs)
+        self.out_specs = [(o.shape, o.dtype) for o in outputs]
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        TapeNode._counter += 1
+        self.index = TapeNode._counter
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    """Eager tensor. ``stop_gradient`` defaults to True (Paddle semantics);
+    Parameters set it False. Holds a ``jax.Array`` (or a tracer inside jit)."""
+
+    __array_priority__ = 100
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.grad = None
+        self._node = None       # creator TapeNode
+        self._out_idx = 0       # which output of the creator
+        self._retain = False
+        self.is_leaf_hint = True
+
+    # -- basic properties ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def T(self):
+        from ..tensor.linalg import transpose_last2
+        return transpose_last2(self)
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._value.devices())[0]
+            return str(dev)
+        except Exception:
+            return 'TracedPlace'
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        return self._value.shape[0]
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=4, separator=', ')
+        except Exception:
+            body = f'<traced {self._value}>'
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __bool__(self):
+        return builtins_bool(self._value)
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(self._value.shape[0]):
+            yield self[i]
+
+    # -- grad machinery --------------------------------------------------
+    def retain_grads(self):
+        self._retain = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from ..core.dispatch import elementwise_op
+        return elementwise_op('clone', lambda x: x + 0, self)
+
+    def _replace_value(self, new_value):
+        """In-place value swap (optimizer updates, set_value)."""
+        if isinstance(new_value, Tensor):
+            new_value = new_value._value
+        self._value = new_value if isinstance(new_value, (jax.Array, jax.core.Tracer)) \
+            else jnp.asarray(new_value)
+        self._node = None
+
+    def set_value(self, value):
+        self._replace_value(value)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward(self, grad_tensor, retain_graph)
+
+    # -- python operators: filled in by paddle_tpu.tensor modules --------
+
+
+def builtins_bool(x):
+    import builtins
+    return builtins.bool(np.asarray(x))
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
+    if root._node is None:
+        # leaf: grad of itself
+        if not root.stop_gradient:
+            g = jnp.ones_like(root._value) if grad_tensor is None else (
+                grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor))
+            root.grad = Tensor(g) if root.grad is None else Tensor(root.grad._value + g)
+        return
+
+    if grad_tensor is None:
+        seed = jnp.ones_like(root._value)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # Collect reachable nodes via DFS, then process in reverse creation order
+    # (creation order is a topological order for an eager tape).
+    nodes = {}
+    stack = [root._node]
+    while stack:
+        n = stack.pop()
+        if n.index in nodes:
+            continue
+        nodes[n.index] = n
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+
+    # pending cotangents keyed by id(tensor)
+    grads = {id(root): seed}
+    tensor_of = {id(root): root}
+
+    for idx in sorted(nodes.keys(), reverse=True):
+        node = nodes[idx]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                'Trying to backward through the graph a second time; '
+                'call backward(retain_graph=True) the first time.')
+        cots = []
+        any_grad = False
+        for i, (shape, dt) in enumerate(node.out_specs):
+            ref = node.out_refs[i]()
+            g = grads.pop(id(ref), None) if ref is not None else None
+            if g is None:
+                cots.append(jnp.zeros(shape, dt))
+            else:
+                any_grad = True
+                cots.append(g)
+        if not any_grad:
+            continue
+        in_grads = node.vjp_fn(tuple(cots))
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if t._node is None or t._retain:
+                # leaf (or retained): accumulate into .grad
+                if not t.stop_gradient:
+                    t.grad = Tensor(g) if t.grad is None else Tensor(t.grad._value + g)
+            if t._node is not None:
+                k = id(t)
+                tensor_of[k] = t
+                grads[k] = g if k not in grads else grads[k] + g
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor — reference: python/paddle/tensor/creation.py:to_tensor."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        v = data
+    else:
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(np.float32)   # paddle default float32
+        v = jnp.asarray(arr)
+    if dtype is not None:
+        v = v.astype(dtypes.convert_dtype(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,46 @@
+"""paddle.save / paddle.load — state dicts and nested pytrees of tensors,
+stored as a pickle of numpy arrays (.pdparams/.pdopt compatible role).
+
+Reference: python/paddle/framework/io.py.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_numpy(obj):
+    if isinstance(obj, Tensor):
+        return ('__tensor__', np.asarray(obj._value))
+    if isinstance(obj, dict):
+        return {k: _to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy(v) for v in obj)
+    return obj
+
+
+def _from_numpy(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == '__tensor__':
+        return Tensor(obj[1])
+    if isinstance(obj, dict):
+        return {k: _from_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_numpy(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_numpy(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'wb') as f:
+        pickle.dump(_to_numpy(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, 'rb') as f:
+        return _from_numpy(pickle.load(f))

@@ -1,0 +1,236 @@
+"""Training callbacks. Reference: python/paddle/hapi/callbacks.py."""
+import os
+import time
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, verbose=2):
+        self.callbacks = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in self.callbacks):
+            self.callbacks.insert(0, ProgBarLogger(verbose=verbose))
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_begin(self, mode, params=None):
+        for c in self.callbacks:
+            c.set_params(params)
+        self._call(f'on_{mode}_begin', params)
+
+    def on_end(self, mode, logs=None):
+        self._call(f'on_{mode}_end', logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call('on_epoch_begin', epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call('on_epoch_end', epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f'on_{mode}_batch_begin', step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f'on_{mode}_batch_end', step, logs)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._step_t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ' - '.join(f'{k}: {v:.4f}' for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)) and k != 'step')
+            dt = (time.time() - self._step_t0) / max(step + 1, 1)
+            print(f'Epoch {self.epoch} step {step}: {items} ({dt * 1000:.1f} ms/step)')
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = ' - '.join(f'{k}: {v:.4f}' for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)) and k != 'step')
+            print(f'Epoch {epoch} done: {items}')
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, '_optimizer', None)
+        if opt is not None and isinstance(opt._lr, Sched):
+            return opt._lr
+        return None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if s is not None and self.by_step:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if s is not None and self.by_epoch:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor='loss', mode='auto', patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == 'auto':
+            mode = 'max' if 'acc' in monitor else 'min'
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == 'min':
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            cur = (logs or {}).get('eval_' + self.monitor)
+        if cur is None:
+            return
+        if self._better(float(cur)):
+            self.best = float(cur)
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """CSV/JSONL logger standing in for the reference's VisualDL writer."""
+
+    def __init__(self, log_dir='./log'):
+        super().__init__()
+        self.log_dir = log_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        import json
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, 'metrics.jsonl'), 'a') as f:
+            f.write(json.dumps({'epoch': epoch, **{k: float(v) for k, v in
+                                                   (logs or {}).items()
+                                                   if isinstance(v, (int, float))}}) + '\n')
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        if mode == 'auto':
+            mode = 'max' if 'acc' in monitor else 'min'
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor) or (logs or {}).get('eval_' + self.monitor)
+        if cur is None:
+            return
+        cur = float(cur)
+        better = (cur < self.best - self.min_delta if self.mode == 'min'
+                  else cur > self.best + self.min_delta) if self.best is not None else True
+        if better:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                opt = self.model._optimizer
+                new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                self.wait = 0

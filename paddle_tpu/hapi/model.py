@@ -1,0 +1,295 @@
+"""High-level Model API: prepare/fit/evaluate/predict.
+
+Reference: python/paddle/hapi/model.py. TPU-native core: the whole train step
+(forward + loss + backward + optimizer update) is ONE jitted XLA program over
+the param pytree — the eager tape is bypassed entirely, giving the compiled
+performance path that the reference gets from static graph + Executor.
+"""
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad_ctx
+from ..nn.layer_base import Layer, functional_call
+from ..tensor.random import rng_scope, next_key
+from ..io import DataLoader, Dataset
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_step = None
+        self._opt_state = None
+        self.stop_training = False
+
+    # ---- setup -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._train_step = None
+        self._eval_step = None
+
+    # ---- functional plumbing --------------------------------------------
+    def _pack(self):
+        net = self.network
+        pnames = [n for n, _ in net.named_parameters()]
+        bnames = [n for n, _ in net.named_buffers()]
+        return pnames, bnames
+
+    def _params_dict(self):
+        return {n: p._value for n, p in self.network.named_parameters()}
+
+    def _buffers_dict(self):
+        return {n: b._value for n, b in self.network.named_buffers()}
+
+    def _write_back(self, params, buffers):
+        for n, p in self.network.named_parameters():
+            p._replace_value(params[n])
+        for n, b in self.network.named_buffers():
+            if n in buffers:
+                b._replace_value(buffers[n])
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        with no_grad_ctx():
+            out_t = [Tensor(o) for o in outs]
+            lab_t = [Tensor(l) for l in labels]
+            loss = self._loss(*out_t, *lab_t)
+        if isinstance(loss, (list, tuple)):
+            total = loss[0]
+            for l in loss[1:]:
+                total = total + l
+            loss = total
+        return loss._value if isinstance(loss, Tensor) else loss
+
+    def _build_train_step(self):
+        net = self.network
+        opt = self._optimizer
+
+        def set_mode(training):
+            for l in net.sublayers(include_self=True):
+                l.training = training
+
+        def step(params, buffers, opt_state, key, lr, inputs, labels):
+            def loss_fn(p):
+                with rng_scope(key):
+                    set_mode(True)
+                    out, new_buf = functional_call(net, p, buffers, *inputs)
+                loss = self._compute_loss(out, labels)
+                return loss, (out, new_buf)
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_state = opt.functional_apply(params, grads,
+                                                         opt_state, lr)
+            return loss, out, new_params, new_buf, new_state
+
+        return jax.jit(step)
+
+    def _build_eval_step(self):
+        net = self.network
+
+        def step(params, buffers, key, inputs, labels):
+            for l in net.sublayers(include_self=True):
+                l.training = False
+            with rng_scope(key):
+                out, _ = functional_call(net, params, buffers, *inputs)
+            loss = None
+            if self._loss is not None and labels:
+                loss = self._compute_loss(out, labels)
+            return loss, out
+
+        return jax.jit(step)
+
+    def _split_batch(self, batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(np.asarray(b))
+                for b in batch]
+        n_in = len(self._inputs) if self._inputs else (
+            len(arrs) - len(self._labels) if self._labels else
+            (len(arrs) - 1 if self._loss is not None and len(arrs) > 1 else len(arrs)))
+        return arrs[:n_in], arrs[n_in:]
+
+    # ---- public batch APIs ----------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+            self._opt_state = self._optimizer.functional_init(self._params_dict())
+        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+                  for t in _to_list(inputs)]
+        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+                  for t in _to_list(labels)]
+        params = self._params_dict()
+        buffers = self._buffers_dict()
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        loss, out, new_p, new_b, new_s = self._train_step(
+            params, buffers, self._opt_state, next_key(), lr,
+            tuple(inputs), tuple(labels))
+        if update:
+            self._write_back(new_p, new_b)
+            self._opt_state = new_s
+            from ..optimizer.lr import LRScheduler
+        return [np.asarray(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+                  for t in _to_list(inputs)]
+        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
+                  for t in _to_list(labels)]
+        loss, out = self._eval_step(self._params_dict(), self._buffers_dict(),
+                                    next_key(), tuple(inputs), tuple(labels))
+        return ([np.asarray(loss)] if loss is not None else None,
+                out)
+
+    def predict_batch(self, inputs):
+        _, out = self.eval_batch(inputs, [])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o) for o in outs]
+
+    # ---- fit/evaluate/predict -------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import CallbackList, ProgBarLogger
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        eval_loader = self._as_loader(eval_data, batch_size, False)
+        cbks = CallbackList(callbacks, self, verbose=verbose)
+        cbks.on_begin('train', {'epochs': epochs,
+                                'steps': len(loader) if hasattr(loader, '__len__') else None,
+                                'metrics': ['loss'] + sum([m.name() if isinstance(m.name(), list)
+                                                           else [m.name()] for m in self._metrics], [])})
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_idx, batch in enumerate(loader):
+                cbks.on_batch_begin('train', step_idx, logs)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {'loss': float(loss[0]), 'step': step_idx}
+                self._update_metrics(logs, inputs, labels)
+                cbks.on_batch_end('train', step_idx, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            from ..optimizer.lr import LRScheduler, ReduceOnPlateau
+            if isinstance(self._optimizer._lr, LRScheduler) and \
+                    not isinstance(self._optimizer._lr, ReduceOnPlateau):
+                self._optimizer._lr.step()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({'eval_' + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbks.on_end('train', logs)
+        if save_dir:
+            self.save(os.path.join(save_dir, 'final'))
+
+    def _update_metrics(self, logs, inputs, labels):
+        if not self._metrics or not labels:
+            return
+        with no_grad_ctx():
+            preds = self.predict_batch([Tensor(i) for i in inputs])
+        for m in self._metrics:
+            res = m.compute(Tensor(jnp.asarray(preds[0])), Tensor(labels[0]))
+            acc = m.update(res)
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = acc if isinstance(acc, list) else [acc]
+            for n, v in zip(names, vals):
+                logs[n] = float(v)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            loss, out = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss[0])
+            if self._metrics and labels:
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for m in self._metrics:
+                    res = m.compute(Tensor(outs[0]), Tensor(labels[0]))
+                    m.update(res)
+        logs = {}
+        if losses:
+            logs['loss'] = float(np.mean([np.asarray(l) for l in losses]))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = float(v)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # ---- persistence -----------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework_io import save as fsave
+        fsave(self.network.state_dict(), path + '.pdparams')
+        if training and self._optimizer is not None:
+            opt_state = {'opt_state': jax.tree_util.tree_map(np.asarray, self._opt_state)
+                         if self._opt_state is not None else None}
+            fsave(opt_state, path + '.pdopt')
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as fload
+        state = fload(path + '.pdparams')
+        self.network.set_state_dict(state)
+        opt_path = path + '.pdopt'
+        if not reset_optimizer and os.path.exists(opt_path):
+            st = fload(opt_path)
+            if st.get('opt_state') is not None:
+                self._opt_state = jax.tree_util.tree_map(jnp.asarray, st['opt_state'])
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from . import summary as _summary
+        return _summary(self.network, input_size, dtype)

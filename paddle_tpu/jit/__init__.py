@@ -1,0 +1,221 @@
+"""paddle.jit — dy2static. Reference: python/paddle/jit/ + fluid/dygraph/jit.py.
+
+TPU-native: ``to_static`` doesn't rewrite Python AST into ProgramDesc like the
+reference (python/paddle/fluid/dygraph/dygraph_to_static); it traces the
+function through jax.jit — the jaxpr IS the static program, and XLA compiles
+it for TPU. Differentiable: the compiled callable is registered on the eager
+tape via jax.vjp, so ``loss.backward()`` crosses the jit boundary.
+``jit.save``/``jit.load`` export params + StableHLO; the inference engine
+(paddle_tpu.inference) AOT-compiles the loaded program.
+"""
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, functional_call, param_arrays, buffer_arrays
+from ..static.input_spec import InputSpec
+from ..tensor.random import rng_scope, next_key
+
+
+class TracedLayer:
+    pass
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+class StaticFunction:
+    """Compiled wrapper around a Python function / Layer.forward."""
+
+    def __init__(self, function, input_spec=None):
+        self._fn = function
+        self._input_spec = input_spec
+        self._layer = getattr(function, '__self__', None)
+        self._cache = {}       # cache_key -> (jitted_pure, holder)
+
+    def _bound_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def _build(self, layer, training, tensor_like, static_ctx, kwargs):
+        fn = self._fn
+        if layer is not None and self._layer is None:
+            fn = functools.partial(self._fn, layer)
+        pnames = static_ctx['pnames']
+        bnames = static_ctx['bnames']
+        static_args = static_ctx['static_args']   # {pos: value}
+        nargs = static_ctx['nargs']
+        holder = {'treedef': None, 'n_out': 0}
+
+        def pure(rng_key, buf_vals, *dyn):
+            dyn_args = dyn[:len(tensor_like)]
+            p_vals = dyn[len(tensor_like):]
+            full_args = [None] * nargs
+            for pos, v in static_args.items():
+                full_args[pos] = v
+            for i, idx in enumerate(tensor_like):
+                full_args[idx] = dyn_args[i]
+            with rng_scope(rng_key):
+                if layer is not None:
+                    pd = dict(zip(pnames, p_vals))
+                    bd = dict(zip(bnames, buf_vals))
+                    was = layer.training
+                    for l in layer.sublayers(include_self=True):
+                        l.training = training
+                    try:
+                        out, new_buf = functional_call(layer, pd, bd,
+                                                       *full_args, **kwargs)
+                    finally:
+                        for l in layer.sublayers(include_self=True):
+                            l.training = was
+                    new_buf_vals = [new_buf[n] for n in bnames]
+                else:
+                    targs = [Tensor(a) if isinstance(a, (jax.Array, jax.core.Tracer,
+                                                         np.ndarray)) else a
+                             for a in full_args]
+                    from ..core.tensor import no_grad_ctx
+                    with no_grad_ctx():
+                        res = fn(*targs, **kwargs)
+                    out = jax.tree_util.tree_map(
+                        lambda x: x._value if isinstance(x, Tensor) else x, res,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                    new_buf_vals = []
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            holder['treedef'] = treedef
+            holder['n_out'] = len(leaves)
+            return tuple(leaves) + tuple(new_buf_vals)
+
+        return jax.jit(pure), holder
+
+    def __call__(self, *args, **kwargs):
+        layer, call_args = self._bound_layer(args)
+        arg_arrays = [a._value if isinstance(a, Tensor) else a for a in call_args]
+        tensor_like = tuple(i for i, a in enumerate(arg_arrays)
+                            if isinstance(a, (jax.Array, np.ndarray, jax.core.Tracer)))
+        static_args = {i: a for i, a in enumerate(arg_arrays) if i not in tensor_like}
+        training = layer.training if layer is not None else False
+
+        if layer is not None:
+            named_p = list(layer.named_parameters())
+            named_b = list(layer.named_buffers())
+            pnames = [n for n, _ in named_p]
+            bnames = [n for n, _ in named_b]
+            params = [p for _, p in named_p]
+            buffers = [b._value for _, b in named_b]
+        else:
+            pnames, bnames, params, buffers = [], [], [], []
+
+        cache_key = (training, tensor_like, len(arg_arrays),
+                     _hashable(static_args), _hashable(kwargs), tuple(pnames))
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            static_ctx = {'pnames': pnames, 'bnames': bnames,
+                          'static_args': static_args, 'nargs': len(arg_arrays)}
+            entry = self._build(layer, training, tensor_like, static_ctx, kwargs)
+            self._cache[cache_key] = entry
+        jitted, holder = entry
+
+        dyn_tensors = [call_args[i] if isinstance(call_args[i], Tensor)
+                       else Tensor(jnp.asarray(arg_arrays[i])) for i in tensor_like]
+        key = next_key()
+        results = apply_op(jitted, Tensor(key), [Tensor(b) for b in buffers],
+                           *dyn_tensors, *params)
+        if not isinstance(results, (list, tuple)):
+            results = (results,)
+        n_out = holder['n_out']
+        out_leaves = list(results[:n_out])
+        new_bufs = results[n_out:]
+        if layer is not None and training:
+            for (n, b), nb in zip(layer.named_buffers(), new_bufs):
+                b._replace_value(nb._value)
+        return jax.tree_util.tree_unflatten(holder['treedef'], out_leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec)
+            return fn
+        sf = StaticFunction(fn, input_spec)
+        functools.update_wrapper(sf, fn) if not isinstance(fn, functools.partial) else None
+        return sf
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def _spec_to_example(spec):
+    shape = [1 if (s is None or s == -1) else int(s) for s in spec.shape]
+    return jnp.zeros(shape, spec.dtype)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Persist params + buffers + StableHLO of the traced forward.
+
+    Mirrors the reference's jit.save (__model__ ProgramDesc + params,
+    python/paddle/fluid/dygraph/jit.py:save); here the portable program
+    format is StableHLO text, consumed by paddle_tpu.inference.Predictor.
+    """
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    from ..framework_io import save as fsave
+    fwd = layer.forward
+    state = {'params': {n: np.asarray(p._value) for n, p in layer.named_parameters()},
+             'buffers': {n: np.asarray(b._value) for n, b in layer.named_buffers()}}
+    fsave(state, path + '.pdparams')
+    if input_spec is None:
+        input_spec = (getattr(fwd, '_input_spec', None) or
+                      getattr(layer, '_input_spec', None))
+    meta = {'class': type(layer).__name__}
+    if input_spec is not None:
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        meta['input_spec'] = [{'shape': [(-1 if d is None else int(d)) for d in s.shape],
+                               'dtype': str(np.dtype(s.dtype).name)} for s in specs]
+        examples = [_spec_to_example(s) for s in specs]
+        pd = {n: p._value for n, p in layer.named_parameters()}
+        bd = {n: b._value for n, b in layer.named_buffers()}
+        was_training = layer.training
+        layer.eval()
+
+        def infer_fn(*xs):
+            out, _ = functional_call(layer, pd, bd, *xs)
+            return out
+        try:
+            lowered = jax.jit(infer_fn).lower(*examples)
+            with open(path + '.stablehlo', 'w') as f:
+                f.write(lowered.as_text())
+        finally:
+            if was_training:
+                layer.train()
+    import json
+    with open(path + '.pdmodel', 'w') as f:
+        json.dump(meta, f)
+
+
+def load(path, **configs):
+    """Returns the saved state dict {params, buffers}. Reconstruct the Layer
+    and ``set_state_dict``, or serve via inference.Predictor."""
+    from ..framework_io import load as fload
+    return fload(path + '.pdparams')

@@ -1,0 +1,80 @@
+"""Gradient clipping. Reference: python/paddle/nn/clip.py (fluid/clip.py)."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def clip_arrays(self, grads):
+        """Pure-array version used inside jitted train steps (list of jax arrays)."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def clip_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max) for g in grads]
+
+    def __call__(self, params_grads):
+        return [(p, None if g is None else Tensor(jnp.clip(g._value, self.min, self.max)))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return g * scale
+
+    def clip_arrays(self, grads):
+        return [None if g is None else self._one(g) for g in grads]
+
+    def __call__(self, params_grads):
+        return [(p, None if g is None else Tensor(self._one(g._value)))
+                for p, g in params_grads]
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = clip_norm
+
+    def clip_arrays(self, grads):
+        sq = [jnp.sum(jnp.square(g)) for g in grads if g is not None]
+        if not sq:
+            return grads
+        gnorm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [None if g is None else g * scale for g in grads]
+
+    def __call__(self, params_grads):
+        grads = [None if g is None else g._value for _, g in params_grads]
+        clipped = self.clip_arrays(grads)
+        return [(p, None if c is None else Tensor(c))
+                for (p, _), c in zip(params_grads, clipped)]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._value for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float('inf'):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g), norm_type))
+                              for g in grads), 1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._replace_value(p.grad._value * scale)
+    return Tensor(total)

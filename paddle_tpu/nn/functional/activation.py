@@ -1,0 +1,183 @@
+"""Functional activations. Reference: python/paddle/nn/functional/activation.py."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+
+@op
+def relu(x, name=None):
+    return jnp.maximum(x, 0)
+
+
+relu_ = relu
+
+
+@op
+def relu6(x, name=None):
+    return jnp.clip(x, 0, 6)
+
+
+@op
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@op
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@op
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@op
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@op
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+@op
+def hardswish(x, name=None):
+    return x * jnp.clip(x / 6 + 0.5, 0, 1)
+
+
+@op
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@op
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+@op
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+@op
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@op
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@op
+def prelu(x, weight, data_format='NCHW', name=None):
+    w = jnp.asarray(weight)
+    if w.size > 1:
+        ax = 1 if data_format == 'NCHW' else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ax] = w.size
+        w = jnp.reshape(w, shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@op
+def rrelu(x, lower=0.125, upper=0.3333, training=False, name=None):
+    slope = (lower + upper) / 2
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@op
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@op
+def maxout(x, groups, axis=1, name=None):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+@op
+def softplus(x, beta=1, threshold=20, name=None):
+    return jnp.where(beta * x > threshold, x,
+                     jnp.log1p(jnp.exp(beta * jnp.minimum(x, threshold / beta))) / beta)
+
+
+@op
+def softsign(x, name=None):
+    return x / (1 + jnp.abs(x))
+
+
+@op
+def swish(x, name=None):
+    return x * jax.nn.sigmoid(x)
+
+
+@op
+def silu(x, name=None):
+    return x * jax.nn.sigmoid(x)
+
+
+@op
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@op
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0)
+
+
+@op
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+softmax_ = softmax
+
+
+@op
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    # NOTE: stochastic; uses a fixed fold-in of the global seed when traced.
+    from ...tensor.random import next_key
+    g = jax.random.gumbel(next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], axis=axis,
+                                dtype=y.dtype)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+@op
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)

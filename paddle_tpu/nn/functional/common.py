@@ -1,0 +1,270 @@
+"""Common functional ops: linear, dropout, padding, interpolate, embedding,
+one_hot, cosine_similarity, pixel_shuffle, unfold.
+
+Reference: python/paddle/nn/functional/common.py, input.py, vision.py.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op, apply_op
+from ...core.tensor import Tensor
+from ...tensor.random import next_key
+
+
+@op
+def linear(x, weight, bias=None, name=None):
+    # paddle stores weight as [in, out]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train', name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+
+    def pure(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == 'upscale_in_train':
+            return jnp.where(keep, v / (1.0 - p), 0)
+        return jnp.where(keep, v, 0)
+    return apply_op(pure, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
+    axis = [0, 1] if data_format == 'NCHW' else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', name=None):
+    axis = [0, 1] if data_format == 'NCDHW' else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def pure(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / (scale * ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5))
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+    return apply_op(pure, x)
+
+
+@op
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == nd * 2:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle order: last-dim pairs first for NCHW-style formats
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.startswith('NC'):
+            dims = list(range(nd - n_spatial, nd))
+        else:
+            dims = list(range(1, 1 + n_spatial))
+        # paddle pads are [left, right, top, bottom,...] innermost-first
+        for i, d in enumerate(reversed(dims)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == 'constant':
+        return jnp.pad(x, cfg, mode='constant', constant_values=value)
+    jmode = {'reflect': 'reflect', 'replicate': 'edge', 'circular': 'wrap'}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@op
+def zeropad2d(x, padding, data_format='NCHW', name=None):
+    l, r, t, b = padding
+    cfg = [(0, 0), (0, 0), (t, b), (l, r)] if data_format == 'NCHW' else \
+          [(0, 0), (t, b), (l, r), (0, 0)]
+    return jnp.pad(x, cfg)
+
+
+@op
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = jnp.asarray(x).astype(jnp.int32)
+    out = jnp.take(weight, idx, axis=0)
+    if padding_idx is not None:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+@op
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(jnp.asarray(x).astype(jnp.int32), num_classes)
+
+
+@op
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * jnp.asarray(prior_dist)
+    return (1 - epsilon) * label + epsilon / k
+
+
+@op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op
+def pixel_shuffle(x, upscale_factor, data_format='NCHW', name=None):
+    r = upscale_factor
+    if data_format == 'NCHW':
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+@op
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), 'VALID', rhs_dilation=(dh, dw),
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    return jnp.reshape(patches, (n, c * kh * kw, oh * ow))
+
+
+@op
+def interpolate(x, size=None, scale_factor=None, mode='nearest',
+                align_corners=False, align_mode=0, data_format='NCHW', name=None):
+    if data_format in ('NCHW', 'NCW', 'NCDHW'):
+        spatial = list(x.shape[2:])
+        chan_first = True
+    else:
+        spatial = list(x.shape[1:-1])
+        chan_first = False
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        size = [int(s.item() if isinstance(s, Tensor) else s) for s in size]
+    if chan_first:
+        out_shape = tuple(x.shape[:2]) + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    method = {'nearest': 'nearest', 'bilinear': 'bilinear', 'trilinear': 'trilinear',
+              'bicubic': 'bicubic', 'linear': 'linear', 'area': 'linear'}[mode]
+    if method == 'trilinear':
+        method = 'linear'
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode='nearest',
+             align_corners=False, align_mode=0, data_format='NCHW', name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@op
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, c, h, w = [int(s) for s in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # [h, w, 3]
+    return jnp.einsum('hwk,nik->nhwi', base, theta)
+
+
+@op
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True, name=None):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        ix = (gx + 1) / 2 * (w - 1)
+        iy = (gy + 1) / 2 * (h - 1)
+    else:
+        ix = ((gx + 1) * w - 1) / 2
+        iy = ((gy + 1) * h - 1) / 2
+    ix0 = jnp.floor(ix)
+    iy0 = jnp.floor(iy)
+    ix1, iy1 = ix0 + 1, iy0 + 1
+
+    def sample(iy_, ix_):
+        iyc = jnp.clip(iy_, 0, h - 1).astype(jnp.int32)
+        ixc = jnp.clip(ix_, 0, w - 1).astype(jnp.int32)
+        v = x[:, :, iyc, ixc] if False else jnp.take_along_axis(
+            jnp.reshape(x, (n, c, h * w)),
+            jnp.reshape(iyc * w + ixc, (n, 1, -1)).astype(jnp.int32), axis=2)
+        v = jnp.reshape(v, (n, c) + iy_.shape[1:])
+        if padding_mode == 'zeros':
+            valid = ((iy_ >= 0) & (iy_ <= h - 1) & (ix_ >= 0) & (ix_ <= w - 1))
+            v = v * valid[:, None].astype(v.dtype)
+        return v
+
+    w00 = (iy1 - iy) * (ix1 - ix)
+    w01 = (iy1 - iy) * (ix - ix0)
+    w10 = (iy - iy0) * (ix1 - ix)
+    w11 = (iy - iy0) * (ix - ix0)
+    if mode == 'nearest':
+        return sample(jnp.round(iy), jnp.round(ix))
+    out = (sample(iy0, ix0) * w00[:, None] + sample(iy0, ix1) * w01[:, None] +
+           sample(iy1, ix0) * w10[:, None] + sample(iy1, ix1) * w11[:, None])
+    return out
+
+
+@op
+def bilinear(x1, x2, weight, bias=None, name=None):
+    out = jnp.einsum('bi,oij,bj->bo', x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = jnp.reshape(x, (n, seg_num, c, h, w))
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]),
+                             x[:, :-1, fold:2 * fold]], axis=1)
+    mid = x[:, :, 2 * fold:]
+    return jnp.reshape(jnp.concatenate([left, right, mid], axis=2), (nt, c, h, w))
+
+
+@op
+def npair_loss_dummy(x):
+    return x

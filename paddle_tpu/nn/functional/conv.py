@@ -1,0 +1,141 @@
+"""Convolutions via lax.conv_general_dilated (XLA maps these onto the MXU).
+
+Reference: python/paddle/nn/functional/conv.py. Weight layout follows paddle:
+[out_c, in_c/groups, *spatial]. data_format 'NCHW' (paddle default) or 'NHWC'
+(TPU-preferred) both lower natively — XLA picks the layout.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, n, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # nested like [[0,0],[0,0],[1,1],[1,1]]
+    return [tuple(p) for p in padding[-n:]]
+
+
+def _dn(ndim, data_format):
+    if ndim == 1:
+        return ('NCH', 'OIH', 'NCH') if data_format in ('NCL', 'NCHW') else ('NHC', 'OIH', 'NHC')
+    if ndim == 2:
+        return ('NCHW', 'OIHW', 'NCHW') if data_format == 'NCHW' else ('NHWC', 'OIHW', 'NHWC')
+    return ('NCDHW', 'OIDHW', 'NCDHW') if data_format == 'NCDHW' else ('NDHWC', 'OIDHW', 'NDHWC')
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd):
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    pad = _padding(padding, nd)
+    dn = _dn(nd, data_format)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype if x.dtype == jnp.bfloat16 else None)
+    if bias is not None:
+        if dn[2].endswith('C'):
+            out = out + jnp.reshape(bias, (1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * nd)
+    return out
+
+
+@op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+@op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+@op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, nd, output_size=None):
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    dn = _dn(nd, data_format)
+    pad = _padding(padding, nd)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        # transpose conv: effective padding = k - 1 - p (per side), via lax
+        ks = weight.shape[2:]
+        pad_cfg = [(dilation[i] * (ks[i] - 1) - pad[i][0],
+                    dilation[i] * (ks[i] - 1) - pad[i][1]) for i in range(nd)]
+    opad = _tuplize(output_padding, nd) if output_padding else (0,) * nd
+    if not isinstance(pad_cfg, str):
+        pad_cfg = [(p[0], p[1] + opad[i]) for i, p in enumerate(pad_cfg)]
+    # weight layout [in, out/groups, *k] for paddle transpose conv
+    w = jnp.swapaxes(weight, 0, 1)          # -> [out/groups, in, *k]
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if groups > 1:
+        # grouped transpose: block-diagonal trick
+        in_c = weight.shape[0]
+        og = w.shape[0]
+        w = jnp.reshape(w, (groups, og, in_c // groups) + w.shape[2:])
+        outs = []
+        xs = jnp.split(x, groups, axis=1 if dn[0][1] == 'C' else -1)
+        for g in range(groups):
+            outs.append(jax.lax.conv_general_dilated(
+                xs[g], w[g], window_strides=(1,) * nd, padding=pad_cfg,
+                lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn))
+        out = jnp.concatenate(outs, axis=1 if dn[2][1] == 'C' else -1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * nd, padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
+    if bias is not None:
+        if dn[2].endswith('C'):
+            out = out + jnp.reshape(bias, (1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * nd)
+    return out
+
+
+@op
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCL',
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 1, output_size)
+
+
+@op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCHW',
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+@op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCDHW',
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

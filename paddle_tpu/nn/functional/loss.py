@@ -1,0 +1,262 @@
+"""Loss functionals. Reference: python/paddle/nn/functional/loss.py."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op, apply_op
+from ...core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == 'mean':
+        return jnp.mean(out)
+    if reduction == 'sum':
+        return jnp.sum(out)
+    return out
+
+
+@op
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction='mean',
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(input, 1e-30))
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        lbl = jnp.asarray(label).astype(jnp.int32)
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl % logp.shape[axis], axis),
+                                     axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        valid = (lbl != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            w = jnp.take(jnp.asarray(weight), lbl % logp.shape[axis], axis=0)
+            w = jnp.where(valid, w, 0.0)
+            loss = loss * w
+            if reduction == 'mean':
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-9)
+        if reduction == 'mean':
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = jnp.asarray(label).astype(jnp.int32)
+        squeeze = lbl.ndim == logp.ndim
+        if squeeze:
+            lbl_s = jnp.squeeze(lbl, axis=axis)
+        else:
+            lbl_s = lbl
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl_s % logits.shape[axis], axis), axis=axis)
+        loss = -picked
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+@op
+def mse_loss(input, label, reduction='mean', name=None):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op
+def l1_loss(input, label, reduction='mean', name=None):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    d = input - label
+    loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d, delta * (jnp.abs(d) - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@op
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean', name=None):
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    picked = jnp.take_along_axis(input, lbl[:, None] % input.shape[1], axis=1)[:, 0]
+    loss = -picked
+    valid = (lbl != ignore_index)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), lbl % input.shape[1], axis=0)
+        loss = loss * w
+        if reduction == 'mean':
+            return jnp.sum(jnp.where(valid, loss, 0)) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0)), 1e-9)
+    loss = jnp.where(valid, loss, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy(input, label, weight=None, reduction='mean', name=None):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction='mean',
+                                     pos_weight=None, name=None):
+    max_val = jnp.maximum(-logit, 0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op
+def kl_div(input, label, reduction='mean', name=None):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+    if reduction == 'batchmean':
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean', name=None):
+    loss = jnp.maximum(-label * (input - other) + margin, 0)
+    return _reduce(loss, reduction)
+
+
+@op
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean', name=None):
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0))
+    return _reduce(loss, reduction)
+
+
+@op
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction='mean', name=None):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0))
+    return _reduce(loss, reduction)
+
+
+@op
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, epsilon=1e-6,
+                        swap=False, reduction='mean', name=None):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1), 1 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(d_pos - d_neg + margin, 0), reduction)
+
+
+@op
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction='sum', name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        loss = loss * (alpha * label + (1 - alpha) * (1 - label))
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    n = anchor.shape[0]
+    lbl = jnp.reshape(jnp.asarray(labels), (-1, 1))
+    tgt = (lbl == lbl.T).astype(anchor.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), 1))) * 0.25
+    return ce + reg
+
+
+@op
+def ctc_loss_fn(log_probs, labels, input_lengths, label_lengths, blank=0):
+    """CTC forward (log-alpha recursion) via lax.scan.
+    log_probs: [T, B, C] log-softmax scores."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    lab = jnp.asarray(labels).astype(jnp.int32)
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    emit0 = jnp.take_along_axis(log_probs[0], ext, axis=1)       # [B,S]
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(emit0[:, 1])
+
+    same = jnp.concatenate([jnp.zeros((B, 2), jnp.bool_),
+                            ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(same, neg_inf, a2)
+        new = jnp.logaddexp(jnp.logaddexp(a0, a1), a2) + emit
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)      # [T,B,S]
+    t_idx = jnp.asarray(input_lengths).astype(jnp.int32) - 1
+    a_final = jnp.take_along_axis(
+        alphas, t_idx[None, :, None].repeat(S, axis=2), axis=0)[0]  # [B,S]
+    s_last = 2 * jnp.asarray(label_lengths).astype(jnp.int32)
+    ll_blank = jnp.take_along_axis(a_final, s_last[:, None], axis=1)[:, 0]
+    ll_label = jnp.take_along_axis(a_final, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+    return -jnp.logaddexp(ll_blank, ll_label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean'):
+    from .activation import log_softmax
+    lp = log_softmax(log_probs, axis=-1)
+    loss = ctc_loss_fn(lp, labels, input_lengths, label_lengths, blank=blank)
+    if reduction == 'mean':
+        ll = label_lengths._value if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths)
+        return apply_op(lambda l: jnp.mean(l / jnp.maximum(ll.astype(l.dtype), 1)), loss)
+    if reduction == 'sum':
+        return apply_op(lambda l: jnp.sum(l), loss)
+    return loss
+
+
+@op
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    lbl = jax.nn.one_hot(jnp.squeeze(jnp.asarray(label), -1).astype(jnp.int32),
+                         input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = 2 * jnp.sum(input * lbl, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lbl, axis=reduce_dims)
+    return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+
+
+@op
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)

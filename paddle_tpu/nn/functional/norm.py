@@ -1,0 +1,134 @@
+"""Normalization functionals. Reference: python/paddle/nn/functional/norm.py."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op, apply_op
+from ...core.tensor import Tensor
+
+
+@op
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                     1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@op
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def group_norm_fn(x, num_groups, weight=None, bias=None, epsilon=1e-05,
+                  data_format='NCHW'):
+    chan_first = data_format.startswith('NC')
+    if not chan_first:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = num_groups
+    xg = jnp.reshape(x, (n, g, c // g) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + epsilon)
+    out = jnp.reshape(xg, (n, c) + spatial)
+    if weight is not None:
+        out = out * jnp.reshape(weight, (1, c) + (1,) * len(spatial))
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, c) + (1,) * len(spatial))
+    if not chan_first:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@op
+def instance_norm_fn(x, weight=None, bias=None, epsilon=1e-05, data_format='NCHW'):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    c = x.shape[1]
+    if weight is not None:
+        out = out * jnp.reshape(weight, (1, c) + (1,) * (x.ndim - 2))
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, c) + (1,) * (x.ndim - 2))
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format='NCHW', name=None):
+    return instance_norm_fn(x, weight, bias, eps, data_format)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05, data_format='NCHW',
+               use_global_stats=None, mesh_axis=None, name=None):
+    """Returns output; updates running stats in-place on the provided Tensors
+    when training (paddle semantics). ``mesh_axis`` (TPU extension): name of a
+    mesh axis to psum stats over → SyncBatchNorm inside shard_map/pjit.
+    """
+    chan_axis = 1 if data_format.startswith('NC') else -1
+
+    use_batch_stats = training and not use_global_stats
+
+    def pure(v, w, b, rm, rv):
+        axes = tuple(i for i in range(v.ndim) if i != (chan_axis % v.ndim))
+        if use_batch_stats:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.mean(jnp.square(v), axis=axes) - jnp.square(mean)
+            if mesh_axis is not None:
+                mean = jax.lax.pmean(mean, mesh_axis)
+                var = jax.lax.pmean(var + jnp.square(mean), mesh_axis)
+                var = var - jnp.square(mean)
+        else:
+            mean, var = rm, rv
+        shape = [1] * v.ndim
+        shape[chan_axis] = v.shape[chan_axis]
+        out = (v - jnp.reshape(mean, shape)) * jax.lax.rsqrt(
+            jnp.reshape(var, shape) + epsilon)
+        if w is not None:
+            out = out * jnp.reshape(w, shape)
+        if b is not None:
+            out = out + jnp.reshape(b, shape)
+        return out, mean, var
+
+    rm = running_mean._value if isinstance(running_mean, Tensor) else running_mean
+    rv = running_var._value if isinstance(running_var, Tensor) else running_var
+    out, bmean, bvar = apply_op(
+        lambda v, w, b: pure(v, w, b, rm, rv), x, weight, bias)
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        m = momentum
+        running_mean._replace_value(rm * m + bmean._value * (1 - m))
+        running_var._replace_value(rv * m + bvar._value * (1 - m))
+    return out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format='NCHW', name=None):
+    def pure(v):
+        chan_first = data_format.startswith('NC')
+        if not chan_first:
+            v = jnp.moveaxis(v, -1, 1)
+        sq = jnp.square(v)
+        half = size // 2
+        pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2)
+        sq = jnp.pad(sq, pad_cfg)
+        acc = sum(jnp.take(sq, jnp.arange(i, i + v.shape[1]), axis=1)
+                  for i in range(size))
+        out = v / jnp.power(k + alpha * acc / size, beta)
+        if not chan_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(pure, x)

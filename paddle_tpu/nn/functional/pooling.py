@@ -1,0 +1,140 @@
+"""Pooling via lax.reduce_window. Reference: python/paddle/nn/functional/pooling.py."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+
+def _tuplize(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _pool(x, kind, kernel, stride, padding, nd, data_format, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _tuplize(padding, nd) if not isinstance(padding, (list, tuple)) or \
+            all(isinstance(q, int) for q in padding) else padding
+        p = _tuplize(p, nd) if isinstance(p, int) else p
+        pad = [(int(q), int(q)) if isinstance(q, int) else tuple(q) for q in p]
+    chan_first = data_format.startswith('NC')
+    if chan_first:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        if not isinstance(pad, str):
+            pad_cfg = [(0, 0), (0, 0)] + list(pad)
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        if not isinstance(pad, str):
+            pad_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pad_cfg = pad
+    if kind == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pad_cfg)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pad_cfg)
+    if exclusive and not count_include_pad and not isinstance(pad_cfg, str):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+        return summed / counts
+    denom = 1
+    for k in kernel:
+        denom *= k
+    return summed / denom
+
+
+@op
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCL', name=None):
+    return _pool(x, 'max', kernel_size, stride, padding, 1, 'NC' if data_format == 'NCL' else 'NLC')
+
+
+@op
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW', name=None):
+    return _pool(x, 'max', kernel_size, stride, padding, 2, data_format)
+
+
+@op
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCDHW', name=None):
+    return _pool(x, 'max', kernel_size, stride, padding, 3, data_format)
+
+
+@op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format='NCL', name=None):
+    return _pool(x, 'avg', kernel_size, stride, padding, 1,
+                 'NC' if data_format == 'NCL' else 'NLC', exclusive=exclusive)
+
+
+@op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCHW', name=None):
+    return _pool(x, 'avg', kernel_size, stride, padding, 2, data_format,
+                 exclusive=exclusive)
+
+
+@op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCDHW', name=None):
+    return _pool(x, 'avg', kernel_size, stride, padding, 3, data_format,
+                 exclusive=exclusive)
+
+
+def _adaptive(x, out_size, nd, data_format, kind):
+    chan_first = data_format.startswith('NC')
+    spatial = x.shape[2:2 + nd] if chan_first else x.shape[1:1 + nd]
+    out_size = _tuplize(out_size, nd)
+    out_size = tuple(o if o is not None else s for o, s in zip(out_size, spatial))
+    # exact adaptive pooling: split into (possibly unequal) regions; use
+    # mean over index ranges computed via segment trick (static shapes).
+    x_ = x if chan_first else jnp.moveaxis(x, -1, 1)
+    for d in range(nd):
+        in_s = x_.shape[2 + d]
+        out_s = out_size[d]
+        starts = [(i * in_s) // out_s for i in range(out_s)]
+        ends = [-(-((i + 1) * in_s) // out_s) for i in range(out_s)]
+        slices = []
+        for s, e in zip(starts, ends):
+            seg = jnp.take(x_, jnp.arange(s, e), axis=2 + d)
+            red = jnp.max(seg, axis=2 + d, keepdims=True) if kind == 'max' \
+                else jnp.mean(seg, axis=2 + d, keepdims=True)
+            slices.append(red)
+        x_ = jnp.concatenate(slices, axis=2 + d)
+    return x_ if chan_first else jnp.moveaxis(x_, 1, -1)
+
+
+@op
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, 'NCL', 'avg')
+
+
+@op
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):
+    return _adaptive(x, output_size, 2, data_format, 'avg')
+
+
+@op
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW', name=None):
+    return _adaptive(x, output_size, 3, data_format, 'avg')
+
+
+@op
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, 'NCL', 'max')
+
+
+@op
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, 'NCHW', 'max')
+
+
+@op
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, 'NCDHW', 'max')

@@ -1,0 +1,47 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` routes to the Pallas flash-attention kernel on
+TPU when shapes allow, else to the fused XLA softmax path.
+Reference: python/paddle/nn/functional/ (fused attention in incubate).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+
+def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum('...qhd,...khd->...hqk', q, k) * scale
+    if causal:
+        qlen, klen = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), jnp.bool_), k=klen - qlen)
+        scores = jnp.where(cm, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('...hqk,...khd->...qhd', probs, v)
+
+
+@op
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """query/key/value: [batch, seq, heads, head_dim] (paddle layout)."""
+    use_flash = False
+    try:
+        from ...ops.flash_attention import flash_attention_available
+        use_flash = flash_attention_available(query, key, value, attn_mask)
+    except Exception:
+        use_flash = False
+    if use_flash:
+        from ...ops.flash_attention import flash_attention
+        return flash_attention(query, key, value, causal=is_causal)
+    return _sdpa_xla(query, key, value, mask=attn_mask, causal=is_causal)

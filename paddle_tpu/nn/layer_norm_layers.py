@@ -1,0 +1,212 @@
+"""Norm layers. Reference: python/paddle/nn/layer/norm.py."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layer_base import Layer
+from . import functional as F
+from . import initializer as I
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW',
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), bias_attr, is_bias=True)
+        self.register_buffer('_mean', Tensor(jnp.zeros((num_features,))))
+        self.register_buffer('_variance', Tensor(jnp.ones((num_features,))))
+        self._mesh_axis = None   # set by SyncBatchNorm / parallel wrappers
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats,
+            mesh_axis=self._mesh_axis)
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm (accepts act)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, dtype='float32',
+                 data_layout='NCHW', in_place=False, use_global_stats=False,
+                 **kw):
+        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr,
+                         data_layout, use_global_stats)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act == 'relu':
+            return F.relu(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW',
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BatchNorm: stats are pmean'd over the data-parallel mesh
+    axis when run inside shard_map/pjit (TPU-native replacement for the
+    reference's NCCL sync_batch_norm, paddle/fluid/operators/sync_batch_norm_op.cu)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW', name=None,
+                 mesh_axis='dp'):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format)
+        self._mesh_axis = mesh_axis
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer, mesh_axis='dp'):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            new = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      data_format=layer._data_format, mesh_axis=mesh_axis)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new.register_buffer('_mean', layer._mean)
+            new.register_buffer('_variance', layer._variance)
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub, mesh_axis)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(self._normalized_shape, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW', name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_channels,), weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_channels,), bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm_fn(x, self._num_groups, self.weight, self.bias,
+                               self._epsilon, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format='NCL', name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False or bias_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), weight_attr, default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter((num_features,), bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm_fn(x, self.weight, self.bias, self._epsilon)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format='NCHW', name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW', name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format='NCHW', name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral norm of a weight tensor.
+    Reference: python/paddle/nn/layer/norm.py:SpectralNorm."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype='float32'):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from ..tensor.random import next_key
+        import jax
+        self.register_buffer('weight_u', Tensor(
+            jax.random.normal(next_key(), (h,), jnp.float32)))
+        self.register_buffer('weight_v', Tensor(
+            jax.random.normal(next_key(), (w,), jnp.float32)))
+
+    def forward(self, weight):
+        from ..core.dispatch import apply_op
+        import jax
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+        u0 = self.weight_u._value
+        v0 = self.weight_v._value
+
+        def pure(wt):
+            wmat = jnp.moveaxis(wt, dim, 0)
+            shape = wmat.shape
+            wmat = jnp.reshape(wmat, (shape[0], -1))
+            u, v = u0, v0
+            for _ in range(iters):
+                v = wmat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wmat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wmat @ v
+            out = wt / sigma
+            return out
+        return apply_op(pure, weight)

@@ -1,0 +1,281 @@
+"""RNN layers via lax.scan (compile-friendly recurrence).
+
+Reference: python/paddle/nn/layer/rnn.py. The reference runs per-timestep
+kernels (or cuDNN); here the whole sequence is one lax.scan so XLA fuses the
+gate GEMMs per step and pipelines HBM reads.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .layer_base import Layer
+from . import initializer as I
+
+
+def _cell_step(mode, w_ih, w_hh, b_ih, b_hh):
+    def simple(x_t, h):
+        (h_prev,) = h
+        h_new = jnp.tanh(x_t @ w_ih.T + h_prev @ w_hh.T + b_ih + b_hh)
+        return (h_new,), h_new
+
+    def lstm(x_t, state):
+        h_prev, c_prev = state
+        gates = x_t @ w_ih.T + h_prev @ w_hh.T + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c = f * c_prev + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    def gru(x_t, state):
+        (h_prev,) = state
+        gi = x_t @ w_ih.T + b_ih
+        gh = h_prev @ w_hh.T + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * h_prev
+        return (h,), h
+
+    return {'RNN_TANH': simple, 'LSTM': lstm, 'GRU': gru}[mode]
+
+
+class _RNNBase(Layer):
+    MODE = 'RNN_TANH'
+    GATES = 1
+    STATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction='forward',
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ('bidirect', 'bidirectional')
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        g = self.GATES
+        k = 1.0 / (hidden_size ** 0.5)
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                sfx = f'_reverse' if d == 1 else ''
+                self.add_parameter(
+                    f'weight_ih_l{layer}{sfx}',
+                    self.create_parameter((g * hidden_size, in_sz),
+                                          weight_ih_attr,
+                                          default_initializer=I.Uniform(-k, k)))
+                self.add_parameter(
+                    f'weight_hh_l{layer}{sfx}',
+                    self.create_parameter((g * hidden_size, hidden_size),
+                                          weight_hh_attr,
+                                          default_initializer=I.Uniform(-k, k)))
+                self.add_parameter(
+                    f'bias_ih_l{layer}{sfx}',
+                    self.create_parameter((g * hidden_size,), bias_ih_attr,
+                                          default_initializer=I.Uniform(-k, k)))
+                self.add_parameter(
+                    f'bias_hh_l{layer}{sfx}',
+                    self.create_parameter((g * hidden_size,), bias_hh_attr,
+                                          default_initializer=I.Uniform(-k, k)))
+
+    def _weights(self, layer, reverse):
+        sfx = '_reverse' if reverse else ''
+        return tuple(self._parameters[f'{n}_l{layer}{sfx}']
+                     for n in ('weight_ih', 'weight_hh', 'bias_ih', 'bias_hh'))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        nl, ndir, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        n_states = self.STATES
+
+        all_params = []
+        for layer in range(nl):
+            for d in range(ndir):
+                all_params.extend(self._weights(layer, d == 1))
+
+        init = None
+        if initial_states is not None:
+            raw = initial_states if isinstance(initial_states, (list, tuple)) \
+                else (initial_states,)
+            init = tuple(s._value if isinstance(s, Tensor) else jnp.asarray(s)
+                         for s in raw)
+
+        def pure(x, *flat_w):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)        # -> [T, B, C]
+            B = x.shape[1]
+            widx = 0
+            outs = x
+            finals_h = []
+            finals_c = []
+            for layer in range(nl):
+                layer_outs = []
+                for d in range(ndir):
+                    w_ih, w_hh, b_ih, b_hh = flat_w[widx:widx + 4]
+                    widx += 4
+                    step = _cell_step(mode, w_ih, w_hh, b_ih, b_hh)
+                    if init is not None:
+                        h0 = tuple(jnp.asarray(s)[layer * ndir + d] for s in init)
+                    else:
+                        h0 = tuple(jnp.zeros((B, hs), x.dtype) for _ in range(n_states))
+                    seq = jnp.flip(outs, 0) if d == 1 else outs
+                    final, ys = jax.lax.scan(lambda c, xt: step(xt, c), h0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    layer_outs.append(ys)
+                    finals_h.append(final[0])
+                    if n_states == 2:
+                        finals_c.append(final[1])
+                outs = jnp.concatenate(layer_outs, axis=-1) if ndir == 2 else layer_outs[0]
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            h_n = jnp.stack(finals_h, 0)
+            if n_states == 2:
+                return outs, h_n, jnp.stack(finals_c, 0)
+            return outs, h_n
+
+        res = apply_op(pure, inputs, *all_params)
+        if n_states == 2:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = 'RNN_TANH'
+    GATES = 1
+    STATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction='forward',
+                 time_major=False, dropout=0.0, activation='tanh', **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = 'LSTM'
+    GATES = 4
+    STATES = 2
+
+
+class GRU(_RNNBase):
+    MODE = 'GRU'
+    GATES = 3
+    STATES = 1
+
+
+class _CellBase(Layer):
+    MODE = 'RNN_TANH'
+    GATES = 1
+    STATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = self.GATES
+        k = 1.0 / (hidden_size ** 0.5)
+        self.weight_ih = self.create_parameter((g * hidden_size, input_size),
+                                               weight_ih_attr,
+                                               default_initializer=I.Uniform(-k, k))
+        self.weight_hh = self.create_parameter((g * hidden_size, hidden_size),
+                                               weight_hh_attr,
+                                               default_initializer=I.Uniform(-k, k))
+        self.bias_ih = self.create_parameter((g * hidden_size,), bias_ih_attr,
+                                             default_initializer=I.Uniform(-k, k))
+        self.bias_hh = self.create_parameter((g * hidden_size,), bias_hh_attr,
+                                             default_initializer=I.Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        n_states = self.STATES
+        mode = self.MODE
+        hs = self.hidden_size
+
+        def pure(x, w_ih, w_hh, b_ih, b_hh, *state):
+            if not state:
+                state = tuple(jnp.zeros((x.shape[0], hs), x.dtype)
+                              for _ in range(n_states))
+            step = _cell_step(mode, w_ih, w_hh, b_ih, b_hh)
+            new_state, y = step(x, state)
+            return (y,) + tuple(new_state)
+
+        state_args = []
+        if states is not None:
+            state_args = list(states) if isinstance(states, (list, tuple)) else [states]
+        res = apply_op(pure, inputs, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, *state_args)
+        y = res[0]
+        new_states = res[1:]
+        if n_states == 1:
+            return y, new_states[0]
+        return y, tuple(new_states)
+
+
+class SimpleRNNCell(_CellBase):
+    MODE = 'RNN_TANH'
+    GATES = 1
+    STATES = 1
+
+
+class LSTMCell(_CellBase):
+    MODE = 'LSTM'
+    GATES = 4
+    STATES = 2
+
+
+class GRUCell(_CellBase):
+    MODE = 'GRU'
+    GATES = 3
+    STATES = 1
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence scanner. Reference: nn/layer/rnn.py:RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = []
+        state = initial_states
+        from ..tensor.manipulation import stack
+        for t in steps:
+            x_t = inputs[t] if self.time_major else inputs[:, t]
+            y, state = self.cell(x_t, state)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ..tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,63 @@
+"""nn.utils: weight_norm / spectral_norm reparameterizations.
+
+Reference: python/paddle/nn/utils/weight_norm_hook.py, spectral_norm_hook.py.
+"""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from .layer_base import Parameter
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name='weight', dim=0):
+    w = layer._parameters.pop(name)
+    dim = dim if dim is not None else 0
+    g0 = _norm_except(w._value, dim)
+    layer.add_parameter(name + '_g', Parameter(g0))
+    layer.add_parameter(name + '_v', Parameter(w._value))
+
+    def hook(lyr, inputs):
+        g = lyr._parameters[name + '_g']
+        v = lyr._parameters[name + '_v']
+        w_t = apply_op(lambda gv, vv: vv * (gv / _norm_except(vv, dim)), g, v)
+        object.__setattr__(lyr, name, w_t)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    g = layer._parameters.pop(name + '_g')
+    v = layer._parameters.pop(name + '_v')
+    w = v._value * (g._value / _norm_except(v._value, 0))
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    if getattr(layer, '_weight_norm_handle', None) is not None:
+        layer._weight_norm_handle.remove()
+    return layer
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12, dim=None):
+    from .layer_norm_layers import SpectralNorm
+    w = layer._parameters.pop(name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + '_sn', sn)
+    layer.add_parameter(name + '_orig', w)
+
+    def hook(lyr, inputs):
+        w_t = lyr._sub_layers[name + '_sn'](lyr._parameters[name + '_orig'])
+        object.__setattr__(lyr, name, w_t)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

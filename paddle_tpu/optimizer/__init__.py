@@ -1,0 +1,199 @@
+"""Optimizers. Reference: python/paddle/optimizer/*.py."""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+from . import lr  # noqa: F401
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, g, p, state, lr):
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, p):
+        return {'velocity': jnp.zeros_like(p)}
+
+    def _update(self, g, p, state, lr):
+        lr = lr.astype(p.dtype)
+        v = self._momentum * state['velocity'] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {'velocity': v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def init_state(self, p):
+        return {'moment1': jnp.zeros_like(p), 'moment2': jnp.zeros_like(p),
+                'beta1_pow': jnp.ones((), jnp.float32),
+                'beta2_pow': jnp.ones((), jnp.float32)}
+
+    def _update(self, g, p, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1p).astype(p.dtype)
+        vhat = v / (1 - b2p).astype(p.dtype)
+        p_new = p - lr.astype(p.dtype) * mhat / (jnp.sqrt(vhat) + eps)
+        return p_new, {'moment1': m, 'moment2': v, 'beta1_pow': b1p,
+                       'beta2_pow': b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay. Reference: python/paddle/optimizer/adamw.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lr_ratio=None, apply_decay_param_fun=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, g, p, state, lr):
+        p = p * (1 - lr.astype(p.dtype) * self._coeff)
+        return super()._update(g, p, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {'moment': jnp.zeros_like(p), 'inf_norm': jnp.zeros_like(p),
+                'beta1_pow': jnp.ones((), jnp.float32)}
+
+    def _update(self, g, p, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state['beta1_pow'] * b1
+        m = b1 * state['moment'] + (1 - b1) * g
+        u = jnp.maximum(b2 * state['inf_norm'], jnp.abs(g) + eps)
+        p_new = p - (lr / (1 - b1p)).astype(p.dtype) * m / u
+        return p_new, {'moment': m, 'inf_norm': u, 'beta1_pow': b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, p):
+        return {'moment': jnp.full_like(p, self._init_acc)}
+
+    def _update(self, g, p, state, lr):
+        acc = state['moment'] + jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(acc) + self._eps)
+        return p_new, {'moment': acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_state(self, p):
+        return {'avg_squared_grad': jnp.zeros_like(p),
+                'avg_squared_update': jnp.zeros_like(p)}
+
+    def _update(self, g, p, state, lr):
+        rho, eps = self._rho, self._eps
+        asg = rho * state['avg_squared_grad'] + (1 - rho) * jnp.square(g)
+        update = -jnp.sqrt((state['avg_squared_update'] + eps) / (asg + eps)) * g
+        asu = rho * state['avg_squared_update'] + (1 - rho) * jnp.square(update)
+        return p + lr.astype(p.dtype) * update, \
+            {'avg_squared_grad': asg, 'avg_squared_update': asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_state(self, p):
+        s = {'mean_square': jnp.zeros_like(p), 'momentum': jnp.zeros_like(p)}
+        if self._centered:
+            s['mean_grad'] = jnp.zeros_like(p)
+        return s
+
+    def _update(self, g, p, state, lr):
+        rho, eps = self._rho, self._eps
+        ms = rho * state['mean_square'] + (1 - rho) * jnp.square(g)
+        if self._centered:
+            mg = rho * state['mean_grad'] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state['momentum'] + lr.astype(p.dtype) * g / denom
+        new_state = {'mean_square': ms, 'momentum': mom}
+        if self._centered:
+            new_state['mean_grad'] = mg
+        return p - mom, new_state
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large-batch training.
+    Reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        return {'moment1': jnp.zeros_like(p), 'moment2': jnp.zeros_like(p),
+                'beta1_pow': jnp.ones((), jnp.float32),
+                'beta2_pow': jnp.ones((), jnp.float32)}
+
+    def _update(self, g, p, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1p).astype(p.dtype)
+        vhat = v / (1 - b2p).astype(p.dtype)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p_new = p - lr.astype(p.dtype) * trust * r
+        return p_new, {'moment1': m, 'moment2': v, 'beta1_pow': b1p,
+                       'beta2_pow': b2p}

@@ -1,0 +1,17 @@
+"""Regularizers. Reference: python/paddle/regularizer.py."""
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self._coeff})'
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

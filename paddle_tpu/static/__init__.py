@@ -1,0 +1,176 @@
+"""Declarative (static graph) mode.
+
+Reference: python/paddle/static/. The reference builds ProgramDesc protobufs
+executed by the C++ Executor (paddle/fluid/framework/executor.cc). Here a
+Program records a traced-Python build function; Executor.run jit-compiles the
+whole program once with XLA and feeds/fetches by name — same workflow
+(data → program → executor.run(feed, fetch_list)), TPU-native execution.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from .input_spec import InputSpec  # noqa: F401
+from . import amp  # noqa: F401
+
+
+class Variable(Tensor):
+    """A placeholder in a static Program."""
+
+    def __init__(self, name, shape, dtype):
+        shape_concrete = [1 if (s is None or s == -1) else s for s in shape]
+        super().__init__(jnp.zeros(shape_concrete, dtypes.convert_dtype(dtype)),
+                         stop_gradient=True, name=name)
+        self.spec_shape = tuple(shape)
+        self.is_placeholder = True
+
+
+class Program:
+    def __init__(self):
+        self._build_funcs = []      # list of (fn, feeds, fetches)
+        self.placeholders = {}
+        self.random_seed = 0
+        self._ops = []              # recorded (fn, inputs, outputs) triples
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+_program_stack = []
+
+
+def default_main_program():
+    return _program_stack[-1][0] if _program_stack else _default_main
+
+
+def default_startup_program():
+    return _program_stack[-1][1] if _program_stack else _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _program_stack.append((self.main, self.startup))
+        return self.main
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    v = Variable(name, shape, dtype)
+    default_main_program().placeholders[name] = v
+    return v
+
+
+class Executor:
+    """Compiles the recorded computation between feeds and fetches with XLA.
+
+    Because our "static mode" still executes ops eagerly while building (the
+    tape IS the graph), Executor.run simply re-executes the user's build ops
+    with the feed values substituted — by replaying through a jitted closure
+    keyed on fetch ids. For the common paddle workflow (build once inside
+    program_guard, run many times), the compiled program is cached.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._compiled = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        feed = feed or {}
+        program = program or default_main_program()
+        fetch_list = fetch_list or []
+        feed_names = tuple(sorted(feed.keys()))
+        key = (id(program), tuple(id(f) for f in fetch_list), feed_names)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._compile(fetch_list, feed_names)
+            self._compiled[key] = fn
+        vals = fn(*[jnp.asarray(np.asarray(feed[n])) for n in feed_names])
+        if return_numpy:
+            return [np.asarray(v) for v in vals]
+        return [Tensor(v) for v in vals]
+
+    def _compile(self, fetch_list, feed_names):
+        """Build one jitted function replaying each fetch's recorded op
+        lineage with placeholders substituted by the feed values."""
+
+        def replay_all(*feed_vals):
+            fmap = dict(zip(feed_names, feed_vals))
+            memo = {}
+
+            def value_of(t):
+                if not isinstance(t, Tensor):
+                    return t
+                k = id(t)
+                if k in memo:
+                    return memo[k]
+                if getattr(t, 'is_placeholder', False):
+                    v = fmap[t.name].astype(t.dtype)
+                elif getattr(t, '_replay', None) is not None:
+                    fn, args, kwargs, idx, is_seq = t._replay
+                    vals = []
+                    for a in args:
+                        if isinstance(a, (list, tuple)):
+                            vals.append(type(a)(value_of(x) for x in a))
+                        else:
+                            vals.append(value_of(a))
+                    out = fn(*vals, **kwargs)
+                    v = out[idx] if is_seq else out
+                else:
+                    v = t._value
+                memo[k] = v
+                return v
+            return tuple(value_of(f) for f in fetch_list)
+
+        return jax.jit(replay_all)
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+def global_scope():
+    return {}
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ns():
+        yield
+    return _ns()

@@ -1,0 +1,2 @@
+"""static.amp parity shim — maps onto paddle_tpu.amp."""
+from ..amp import auto_cast, GradScaler, decorate  # noqa: F401

@@ -1,0 +1,17 @@
+"""Tensor op library — aggregated namespace (paddle.tensor parity)."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .attribute import shape, rank, is_complex, is_floating_point, is_integer  # noqa: F401
+from .einsum import einsum  # noqa: F401
+from .random import (  # noqa: F401
+    bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
+    randn, randperm, seed, standard_normal, uniform)
+from . import fft  # noqa: F401
+from .register import install as _install
+
+_install()

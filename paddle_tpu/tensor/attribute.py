@@ -1,0 +1,24 @@
+"""Tensor attribute helpers. Reference: python/paddle/tensor/attribute.py."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)

@@ -1,0 +1,141 @@
+"""Creation ops. Reference: python/paddle/tensor/creation.py."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op, apply_op
+from ..core.tensor import Tensor, to_tensor
+from ..core import dtype as dtypes
+
+
+def _dt(dtype, default='float32'):
+    return dtypes.convert_dtype(dtype if dtype is not None else default)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value if isinstance(s, Tensor) else s) for s in shape)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+@op
+def ones_like(x, dtype=None, name=None):
+    return jnp.ones_like(x, dtype=dtypes.convert_dtype(dtype))
+
+
+@op
+def zeros_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=dtypes.convert_dtype(dtype))
+
+
+@op
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(x, fill_value, dtype=dtypes.convert_dtype(dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = 'int64' if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else 'float32'
+    return Tensor(jnp.arange(start, end, step, dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@op
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+        idx = jnp.arange(x.shape[0])
+        r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+        return base.at[r, c].set(x)
+    return jnp.diag(x, k=offset)
+
+
+@op
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+@op
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+@op
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_op(lambda xs: list(jnp.meshgrid(*xs, indexing='ij')), list(args))
+
+
+@op
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@op
+def complex(real, imag, name=None):
+    return jnp.asarray(real) + 1j * jnp.asarray(imag)
+
+
+@op
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tolist(x):
+    return x.tolist()

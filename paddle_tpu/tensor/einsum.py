@@ -1,0 +1,11 @@
+"""Einsum. Reference: python/paddle/tensor/einsum.py — here a direct jnp.einsum
+lowering (XLA maps contractions onto the MXU)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(lambda xs: jnp.einsum(equation, *xs), list(operands))

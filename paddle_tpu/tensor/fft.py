@@ -1,0 +1,84 @@
+"""FFT ops. Reference: python/paddle/tensor/fft.py."""
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+
+@op
+def fft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def ifft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def rfft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def irfft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def fft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def ifft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def fftn(x, s=None, axes=None, norm='backward', name=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def ifftn(x, s=None, axes=None, norm='backward', name=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def rfft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def irfft2(x, s=None, axes=(-2, -1), norm='backward', name=None):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=norm)
+
+
+@op
+def hfft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def ihfft(x, n=None, axis=-1, norm='backward', name=None):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)
+
+
+@op
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@op
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))

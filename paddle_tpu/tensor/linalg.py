@@ -1,0 +1,183 @@
+"""Linear algebra ops. Reference: python/paddle/tensor/linalg.py."""
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+
+@op
+def transpose_last2(x):
+    return jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+
+
+@op
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    if p == 'fro':
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                                keepdims=keepdim))
+    if p == float('inf'):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float('-inf'):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=ax, keepdims=keepdim), 1.0 / p)
+
+
+@op
+def dist(x, y, p=2, name=None):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype)).astype(x.dtype)
+    if p == float('inf'):
+        return jnp.max(d)
+    if p == float('-inf'):
+        return jnp.min(d)
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@op
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@op
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.cho_solve((y, not upper), x)
+
+
+@op
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@op
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@op
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    from ..core.dispatch import apply_op
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, tol), x)
+
+
+@op
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+@op
+def qr(x, mode='reduced', name=None):
+    return tuple(jnp.linalg.qr(x, mode=mode)) if mode != 'r' else jnp.linalg.qr(x, mode='r')
+
+
+@op
+def eig(x, name=None):
+    return tuple(jnp.linalg.eig(x))
+
+
+@op
+def eigh(x, UPLO='L', name=None):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+@op
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+@op
+def eigvalsh(x, UPLO='L', name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@op
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(x, y, lower=not upper, trans=1 if transpose else 0,
+                                unit_diagonal=unitriangular)
+
+
+@op
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+@op
+def bmm(x, y, name=None):
+    return jnp.einsum('bij,bjk->bik', x, y)
+
+
+@op
+def histogram(input, bins=100, min=0, max=0, name=None):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    return jnp.histogram(input, bins=bins, range=(lo, hi))[0].astype(jnp.int64)
+
+
+@op
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+@op
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@op
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(x)
+
+
+@op
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

@@ -1,0 +1,79 @@
+"""Logic/comparison ops. Reference: python/paddle/tensor/logic.py."""
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+
+@op
+def equal(x, y, name=None):
+    return jnp.equal(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def not_equal(x, y, name=None):
+    return jnp.not_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def greater_than(x, y, name=None):
+    return jnp.greater(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def less_than(x, y, name=None):
+    return jnp.less(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def less_equal(x, y, name=None):
+    return jnp.less_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+@op
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@op
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@op
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@op
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@op
+def equal_all(x, y, name=None):
+    return jnp.all(jnp.equal(x, y))
+
+
+@op
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)

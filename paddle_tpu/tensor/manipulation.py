@@ -1,0 +1,345 @@
+"""Shape/layout manipulation ops. Reference: python/paddle/tensor/manipulation.py."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op, apply_op
+from ..core.tensor import Tensor
+
+
+def _static_shape(shape):
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(np.asarray(s._value)))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+@op
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, _static_shape(shape))
+
+
+reshape_ = reshape
+
+
+@op
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if stop_axis < 0:
+        stop_axis += nd
+    if start_axis < 0:
+        start_axis += nd
+    shape = list(x.shape)
+    mid = 1
+    for s in shape[start_axis:stop_axis + 1]:
+        mid *= s
+    return jnp.reshape(x, tuple(shape[:start_axis]) + (mid,) + tuple(shape[stop_axis + 1:]))
+
+
+@op
+def transpose(x, perm, name=None):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+@op
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op
+def swapaxes(x, axis1, axis2, name=None):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@op
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+squeeze_ = squeeze
+
+
+@op
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+unsqueeze_ = unsqueeze
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda xs: jnp.concatenate([jnp.asarray(v) for v in xs], axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda xs: jnp.stack([jnp.asarray(v) for v in xs], axis=axis), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s < 0)
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections).tolist()
+
+    def pure(v):
+        return [jnp.take(v, jnp.arange(offsets[i], offsets[i + 1]), axis=axis)
+                for i in range(len(sections))]
+    return apply_op(pure, x)
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    return apply_op(
+        lambda v: [jnp.squeeze(jnp.take(v, jnp.array([i]), axis=axis), axis=axis)
+                   for i in range(n)], input)
+
+
+@op
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, _static_shape(repeat_times))
+
+
+@op
+def expand(x, shape, name=None):
+    shape = _static_shape(shape)
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@op
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@op
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, _static_shape(shape))
+
+
+def broadcast_tensors(input, name=None):
+    return apply_op(lambda xs: list(jnp.broadcast_arrays(*xs)), list(input))
+
+
+@op
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+@op
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@op
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@op
+def gather(x, index, axis=0, name=None):
+    idx = jnp.reshape(jnp.asarray(index), (-1,))
+    if isinstance(axis, (Tensor,)):
+        axis = int(axis.item())
+    return jnp.take(x, idx.astype(jnp.int32), axis=axis)
+
+
+@op
+def gather_nd(x, index, name=None):
+    index = jnp.asarray(index).astype(jnp.int32)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@op
+def scatter(x, index, updates, overwrite=True, name=None):
+    index = jnp.reshape(jnp.asarray(index), (-1,)).astype(jnp.int32)
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(jnp.asarray(updates)))
+    return base.at[index].add(updates)
+
+
+@op
+def scatter_nd_add(x, index, updates, name=None):
+    index = jnp.asarray(index).astype(jnp.int32)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else 'float32')
+    return scatter_nd_add(base, index, updates)
+
+
+@op
+def put_along_axis(arr, indices, values, axis, reduce='assign'):
+    indices = jnp.asarray(indices).astype(jnp.int32)
+    if reduce == 'add':
+        f = lambda a, i, v: a.at[i].add(v)
+    elif reduce == 'multiply':
+        f = lambda a, i, v: a.at[i].multiply(v)
+    else:
+        f = lambda a, i, v: a.at[i].set(v)
+    idx = []
+    for d in range(arr.ndim):
+        if d == axis:
+            idx.append(indices)
+        else:
+            sh = [1] * arr.ndim
+            sh[d] = arr.shape[d]
+            idx.append(jnp.reshape(jnp.arange(arr.shape[d]), sh))
+    return f(arr, tuple(jnp.broadcast_arrays(*idx)), values)
+
+
+@op
+def take_along_axis(arr, indices, axis):
+    return jnp.take_along_axis(arr, jnp.asarray(indices).astype(jnp.int32), axis=axis)
+
+
+@op
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, jnp.reshape(jnp.asarray(index), (-1,)).astype(jnp.int32), axis=axis)
+
+
+@op
+def index_sample(x, index):
+    index = jnp.asarray(index).astype(jnp.int32)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@op
+def slice(input, axes, starts, ends):
+    idx = [jnp.arange(0, s) for s in input.shape]
+    sl = [None] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = input.shape[ax]
+        st = int(st) if not isinstance(st, Tensor) else int(st.item())
+        en = int(en) if not isinstance(en, Tensor) else int(en.item())
+        if st < 0:
+            st += dim
+        if en < 0:
+            en += dim
+        en = builtins_min(en, dim)
+        sl[ax] = (st, en)
+    slicer = tuple(jnp.s_[s[0]:s[1]] if s is not None else jnp.s_[:] for s in sl)
+    return input[slicer]
+
+
+def builtins_min(a, b):
+    import builtins
+    return builtins.min(a, b)
+
+
+@op
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    slicer = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slicer[ax] = jnp.s_[st:en:sd]
+    return x[tuple(slicer)]
+
+
+@op
+def unique_consecutive_vals(x):
+    return x
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    vals = np.unique(np.asarray(x._value if isinstance(x, Tensor) else x),
+                     return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(vals, tuple):
+        return Tensor(jnp.asarray(vals))
+    return tuple(Tensor(jnp.asarray(v)) for v in vals)
+
+
+@op
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1]) if False else x[..., 0] + 1j * x[..., 1]
+
+
+@op
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op
+def real(x, name=None):
+    return jnp.real(x)
+
+
+@op
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+@op
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype))
+
+
+@op
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _static_shape(shape)
+    offsets = _static_shape(offsets) if offsets is not None else (0,) * len(shape)
+    slicer = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[slicer]
+
+
+@op
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    mask = (input // size) == shard_id
+    return jnp.where(mask, input % size, ignore_value)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+import jax  # noqa: E402  (used by as_complex)

@@ -1,0 +1,280 @@
+"""Math ops. Reference: python/paddle/tensor/math.py (~120 ops)."""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op, apply_op
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+_mod = sys.modules[__name__]
+
+# ---- table-generated unary ops --------------------------------------------
+_UNARY = {
+    'abs': jnp.abs, 'acos': jnp.arccos, 'asin': jnp.arcsin, 'atan': jnp.arctan,
+    'acosh': jnp.arccosh, 'asinh': jnp.arcsinh, 'atanh': jnp.arctanh,
+    'ceil': jnp.ceil, 'cos': jnp.cos, 'cosh': jnp.cosh, 'exp': jnp.exp,
+    'expm1': jnp.expm1, 'floor': jnp.floor, 'log': jnp.log, 'log2': jnp.log2,
+    'log10': jnp.log10, 'log1p': jnp.log1p, 'neg': jnp.negative,
+    'reciprocal': jnp.reciprocal, 'round': jnp.round, 'rsqrt': jax.lax.rsqrt,
+    'sign': jnp.sign, 'sin': jnp.sin, 'sinh': jnp.sinh, 'sqrt': jnp.sqrt,
+    'square': jnp.square, 'tan': jnp.tan, 'tanh': jnp.tanh,
+    'erf': jax.scipy.special.erf, 'erfinv': jax.scipy.special.erfinv,
+    'digamma': jax.scipy.special.digamma, 'lgamma': jax.scipy.special.gammaln,
+    'angle': jnp.angle, 'conj': jnp.conj, 'trunc': jnp.trunc,
+    'frac': lambda x: x - jnp.trunc(x),
+}
+for _name, _fn in _UNARY.items():
+    def _make(fn):
+        def _f(x, name=None):
+            return fn(x)
+        return _f
+    setattr(_mod, _name, op(_make(_fn)))
+
+# ---- table-generated binary ops -------------------------------------------
+_BINARY = {
+    'add': jnp.add, 'subtract': jnp.subtract, 'multiply': jnp.multiply,
+    'divide': jnp.divide, 'floor_divide': jnp.floor_divide,
+    'mod': jnp.mod, 'remainder': jnp.mod, 'floor_mod': jnp.mod,
+    'pow': jnp.power, 'maximum': jnp.maximum, 'minimum': jnp.minimum,
+    'fmax': jnp.fmax, 'fmin': jnp.fmin, 'atan2': jnp.arctan2,
+    'logaddexp': jnp.logaddexp,
+    'bitwise_and': jnp.bitwise_and, 'bitwise_or': jnp.bitwise_or,
+    'bitwise_xor': jnp.bitwise_xor,
+}
+for _name, _fn in _BINARY.items():
+    def _make2(fn):
+        def _f(x, y, name=None):
+            return fn(jnp.asarray(x), jnp.asarray(y))
+        return _f
+    setattr(_mod, _name, op(_make2(_fn)))
+
+
+@op
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act == 'relu':
+        out = jnp.maximum(out, 0)
+    elif act == 'tanh':
+        out = jnp.tanh(out)
+    return out
+
+
+@op
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, a_min=min, a_max=max)
+
+
+@op
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)           # [n, batch, ...]
+    idx = jnp.reshape(jnp.asarray(index), (-1,)).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@op
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.sum(x, axis=_axes(axis), dtype=dtypes.convert_dtype(dtype),
+                   keepdims=keepdim)
+
+
+@op
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axes(axis), dtype=dtypes.convert_dtype(dtype),
+                      keepdims=keepdim)
+
+
+@op
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axes(axis), dtype=dtypes.convert_dtype(dtype),
+                    keepdims=keepdim)
+
+
+@op
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtypes.convert_dtype(dtype))
+
+
+@op
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtypes.convert_dtype(dtype))
+
+
+@op
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@op
+def mm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@op
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@op
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@op
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@op
+def multiply_(x, y):
+    return jnp.multiply(x, y)
+
+
+@op
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+@op
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@op
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@op
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def broadcast_shape_op(x, y):
+    return jnp.broadcast_arrays(x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@op
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+@op
+def lerp(x, y, weight, name=None):
+    return x + jnp.asarray(weight) * (y - x)
+
+
+@op
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@op
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@op
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@op
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+@op
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@op
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@op
+def log_(x):
+    return jnp.log(x)
+
+
+@op
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+def divide_int_aware(x, y):
+    return divide(x, y)

@@ -1,0 +1,152 @@
+"""Random ops with a global, explicitly-splittable PRNG.
+
+Reference: python/paddle/tensor/random.py + fluid Generator. TPU-native twist:
+a single global JAX PRNG key, split per call; ``paddle_tpu.seed(n)`` resets it.
+Inside jitted/functional code, prefer passing keys explicitly (utils.rng).
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+_lock = threading.Lock()
+_KEY = jax.random.PRNGKey(0)
+
+
+def seed(s):
+    global _KEY
+    with _lock:
+        _KEY = jax.random.PRNGKey(int(s))
+    return _KEY
+
+
+_ctx = threading.local()
+
+
+class rng_scope:
+    """Derive keys from an explicit (possibly traced) base key instead of the
+    global generator — makes stochastic layers (dropout) correct under jit:
+    the base key is a traced argument, so each step gets fresh randomness
+    without retracing."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        if not hasattr(_ctx, 'stack'):
+            _ctx.stack = []
+        _ctx.stack.append([self.key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.stack.pop()
+        return False
+
+
+def next_key():
+    """Fresh subkey: from the innermost rng_scope if active (trace-safe),
+    else by splitting the global key (thread-safe)."""
+    stack = getattr(_ctx, 'stack', None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    global _KEY
+    with _lock:
+        _KEY, sub = jax.random.split(_KEY)
+    return sub
+
+
+def get_rng_state():
+    return _KEY
+
+
+def set_rng_state(state):
+    global _KEY
+    _KEY = state
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype, default='float32'):
+    return dtypes.convert_dtype(dtype if dtype is not None else default)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), sh))
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape or [1])))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     _dt(dtype, 'int64')))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype='int64', name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(_dt(dtype, 'int64')))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(next_key(), v).astype(v.dtype))
+
+
+def poisson(x, name=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(next_key(), v).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = jax.random.exponential(next_key(), tuple(x.shape)) / lam
+    x._replace_value(v.astype(x.dtype))
+    return x

@@ -1,0 +1,135 @@
+"""Attach op library as Tensor methods + Python operators.
+
+Reference analogue: python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py (monkey-patching VarBase).
+"""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, op
+from ..core.tensor import Tensor
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+
+
+def _method(fn):
+    return fn
+
+
+_METHOD_SOURCES = [math, manipulation, logic, linalg, search, stat]
+
+_EXCLUDE = {'shape', 'rank', 'op', 'apply_op', 'Tensor', 'sys', 'jax', 'jnp', 'np',
+            'builtins_sum', 'builtins_min', 'builtins_bool', 'broadcast_shape'}
+
+_FROM_CREATION = ['ones_like', 'zeros_like', 'full_like', 'diag', 'diagflat',
+                  'tril', 'triu', 'tolist']
+
+
+def install():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith('_') or name in _EXCLUDE or name[0].isupper():
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not isinstance(fn, type) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    for name in _FROM_CREATION:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(creation, name))
+
+    # paddle method-only names
+    Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+    Tensor.cast = Tensor.astype
+    Tensor.dim = lambda self: self.ndim
+    Tensor.numel = lambda self: stat.numel(self)
+    Tensor.einsum = None  # not a method
+    del Tensor.einsum
+    Tensor.uniform_ = _inplace_random(random.uniform)
+    Tensor.normal_ = lambda self, mean=0.0, std=1.0: _set_inplace(
+        self, random.normal(mean, std, self.shape))
+    Tensor.zero_ = lambda self: _set_inplace(self, creation.zeros(self.shape, self.dtype))
+    Tensor.fill_ = lambda self, v: _set_inplace(self, creation.full(self.shape, v, self.dtype))
+    Tensor.exponential_ = random.exponential_
+
+    # in-place arithmetic aliases (functional under the hood)
+    for nm in ['add', 'subtract', 'multiply', 'divide', 'clip', 'scale', 'floor',
+               'ceil', 'round', 'sqrt', 'rsqrt', 'reciprocal', 'exp', 'tanh']:
+        base = getattr(math, nm, None) or getattr(manipulation, nm, None)
+        if base is not None:
+            setattr(Tensor, nm + '_', _make_inplace(base))
+
+    # operators
+    Tensor.__add__ = lambda s, o: math.add(s, _c(o))
+    Tensor.__radd__ = lambda s, o: math.add(_c(o), s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, _c(o))
+    Tensor.__rsub__ = lambda s, o: math.subtract(_c(o), s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, _c(o))
+    Tensor.__rmul__ = lambda s, o: math.multiply(_c(o), s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, _c(o))
+    Tensor.__rtruediv__ = lambda s, o: math.divide(_c(o), s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _c(o))
+    Tensor.__mod__ = lambda s, o: math.mod(s, _c(o))
+    Tensor.__pow__ = lambda s, o: math.pow(s, _c(o))
+    Tensor.__rpow__ = lambda s, o: math.pow(_c(o), s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, _c(o))
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(_c(o), s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, _c(o))
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, _c(o))
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, _c(o))
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, _c(o))
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, _c(o))
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, _c(o))
+    Tensor.__and__ = lambda s, o: math.bitwise_and(s, _c(o))
+    Tensor.__or__ = lambda s, o: math.bitwise_or(s, _c(o))
+    Tensor.__xor__ = lambda s, o: math.bitwise_xor(s, _c(o))
+    Tensor.__invert__ = lambda s: math.bitwise_not(s)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+
+def _c(o):
+    return o
+
+
+def _make_inplace(base):
+    def f(self, *args, **kwargs):
+        out = base(self, *args, **kwargs)
+        return _set_inplace(self, out)
+    return f
+
+
+def _inplace_random(gen):
+    def f(self, min=-1.0, max=1.0, seed=0):
+        return _set_inplace(self, random.uniform(self.shape, self.dtype, min=min, max=max))
+    return f
+
+
+def _set_inplace(t, new):
+    t._replace_value(new._value if isinstance(new, Tensor) else new)
+    return t
+
+
+def _norm_index(item):
+    """Convert Tensor indices to jax arrays; pass through slices/ints."""
+    if isinstance(item, tuple):
+        return tuple(_norm_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._value
+    if isinstance(item, (list,)):
+        return jnp.asarray(item)
+    return item
+
+
+def _getitem(self, item):
+    idx = _norm_index(item)
+    return apply_op(lambda v: v[idx], self)
+
+
+def _setitem(self, item, value):
+    idx = _norm_index(item)
+    val = value._value if isinstance(value, Tensor) else value
+    out = apply_op(lambda v, w: v.at[idx].set(w), self,
+                   value if isinstance(value, Tensor) else Tensor(jnp.asarray(val)))
+    self._value = out._value
+    self._node = out._node
+    return self

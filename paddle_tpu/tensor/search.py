@@ -1,0 +1,114 @@
+"""Search/sort ops. Reference: python/paddle/tensor/search.py."""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op, apply_op
+from ..core.tensor import Tensor
+
+
+@op
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+@op
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+@op
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(-x if descending else x, axis=axis)
+    return out.astype(jnp.int64)
+
+
+@op
+def sort(x, axis=-1, descending=False, name=None):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    """Returns (values, indices); indices computed outside the tape so only
+    values carry gradient (gather via take_along_axis keeps the vjp)."""
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    ax = axis if axis >= 0 else v.ndim + axis
+    moved = jnp.moveaxis(v, ax, -1)
+    _, idx = jax.lax.top_k(moved if largest else -moved, k)
+    idx = jnp.moveaxis(idx, -1, ax)
+    from .manipulation import take_along_axis
+    idx_t = Tensor(idx.astype(jnp.int64))
+    vals = take_along_axis(x, idx_t, axis=ax) if isinstance(x, Tensor) else \
+        Tensor(jnp.take_along_axis(v, idx, axis=ax))
+    return vals, idx_t
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals, idx = topk(x, k, axis=axis, largest=False)
+    from .manipulation import take_along_axis
+    from .creation import full
+    ax = axis if axis >= 0 else x.ndim + axis
+    sel = take_along_axis(vals, Tensor(jnp.full([1 if i == ax else s for i, s in enumerate(vals.shape)],
+                                                k - 1, jnp.int32)), axis=ax)
+    sel_idx = take_along_axis(idx, Tensor(jnp.full([1 if i == ax else s for i, s in enumerate(idx.shape)],
+                                                   k - 1, jnp.int32)), axis=ax)
+    if not keepdim:
+        from .manipulation import squeeze
+        sel, sel_idx = squeeze(sel, ax), squeeze(sel_idx, ax)
+    return sel, sel_idx
+
+
+@op
+def where(condition, x=None, y=None, name=None):
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None].astype('int64'))) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype('int64')))
+
+
+@op
+def masked_select_dense(x, mask):
+    return jnp.where(mask, x, 0)
+
+
+def masked_select(x, mask, name=None):
+    import numpy as np
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]))
+
+
+@op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = 'right' if right else 'left'
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            jnp.reshape(sorted_sequence, (-1, sorted_sequence.shape[-1])),
+            jnp.reshape(values, (-1, values.shape[-1])))
+        out = jnp.reshape(out, values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def index_put(x, indices, value, accumulate=False):
+    def pure(v, val):
+        idx = tuple(jnp.asarray(i._value if isinstance(i, Tensor) else i) for i in indices)
+        return v.at[idx].add(val) if accumulate else v.at[idx].set(val)
+    return apply_op(pure, x, value)

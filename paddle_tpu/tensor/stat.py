@@ -1,0 +1,79 @@
+"""Statistics ops. Reference: python/paddle/tensor/stat.py."""
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@op
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op
+def median(x, axis=None, keepdim=False, name=None):
+    if axis is None:
+        return jnp.median(jnp.reshape(x, (-1,)))
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@op
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op
+def numel(x, name=None):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+@op
+def mode(x, axis=-1, keepdim=False, name=None):
+    # mode along axis via sorted-run trick (compile-friendly)
+    sortd = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    eq = jnp.equal(jnp.take(sortd, jnp.arange(1, n), axis=axis),
+                   jnp.take(sortd, jnp.arange(0, n - 1), axis=axis))
+    runlen = jnp.cumsum(eq.astype(jnp.int32), axis=axis)
+    reset = jnp.where(eq, 0, 1)
+    # fallback simple approach: pick value with max count via comparison matrix
+    xm = jnp.moveaxis(x, axis, -1)
+    counts = jnp.sum(xm[..., :, None] == xm[..., None, :], axis=-1)
+    idx = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(xm, idx[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(jnp.moveaxis(vals, -1, -1), axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)

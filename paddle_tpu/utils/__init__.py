@@ -1,0 +1,11 @@
+from . import misc  # noqa: F401
+from .misc import in_dynamic_mode, enable_static, disable_static  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f'{name} is required but not installed '
+                          '(no-egress environment: gate this feature)') from e
