@@ -1,0 +1,64 @@
+"""paddle.distributed parity over JAX single-controller SPMD.
+
+Reference: python/paddle/distributed/__init__.py. Key difference from the
+reference's multi-process NCCL world: JAX is single-controller per host —
+"rank" maps to jax.process_index() (multi-host) and parallelism inside a host
+is expressed with the device mesh, not processes.
+"""
+import os
+
+import jax
+
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
+    new_group, recv, reduce, reduce_scatter, scatter, send, wait)
+from .topology import (  # noqa: F401
+    HybridTopology, get_mesh, get_topology, set_topology)
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from . import fleet  # noqa: F401
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-controller JAX drives all local devices from one process, so
+    spawn degenerates to a direct call (reference: distributed/spawn.py forks
+    one process per GPU)."""
+    func(*args)
+
+
+def launch():
+    from . import launch as launch_mod
+    launch_mod.main()
+
+
+def init_process_group(*args, **kwargs):
+    return init_parallel_env()

@@ -1,0 +1,220 @@
+"""Meta-parallel layers: tensor parallel + pipeline parallel building blocks.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+(mp_layers.py: ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding;
+pp_layers.py: LayerDesc/PipelineLayer) and pipeline_parallel.py (1F1B over
+NCCL p2p).
+
+TPU-native: the TP layers are *GSPMD-annotated* — weights carry a
+PartitionSpec over the 'mp' axis and forward adds sharding constraints, so
+under pjit XLA inserts exactly the all-reduce the reference codes by hand
+(identity fwd + allreduce bwd for column, allreduce fwd for row), scheduled
+over ICI and overlapped with compute. Pipeline runs as a shard_map over the
+'pp' axis with ppermute microbatch rotation (see paddle_tpu.parallel.pipeline
+for the schedule).
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn import initializer as I
+from ...nn import functional as F
+from ..topology import get_topology
+
+
+def _constraint(spec):
+    """with_sharding_constraint that is a no-op outside pjit."""
+    def pure(v):
+        if isinstance(v, jax.core.Tracer):
+            try:
+                return jax.lax.with_sharding_constraint(v, spec)
+            except Exception:
+                return v
+        return v
+    return pure
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out over 'mp'. Output stays mp-sharded when
+    gather_output=False (feeds RowParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.mesh_axes = PartitionSpec(None, 'mp')
+        self.bias = self.create_parameter((out_features,), None, is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            self.bias.mesh_axes = PartitionSpec('mp')
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = PartitionSpec(None, None, None) if self.gather_output else \
+            PartitionSpec(None, None, 'mp')
+        return apply_op(_constraint(spec), y) if y.ndim == 3 else y
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in over 'mp'; XLA inserts the forward
+    all-reduce the reference does with c_allreduce_sum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.mesh_axes = PartitionSpec('mp', None)
+        self.bias = self.create_parameter((out_features,), None, is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if y.ndim == 3:
+            y = apply_op(_constraint(PartitionSpec(None, None, None)), y)
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on vocab over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.mesh_axes = PartitionSpec('mp', None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction='mean')
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        import jax
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name='global_seed'):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            yield
+        return _cm()
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    from ...tensor.random import seed as set_seed
+    set_seed(seed or 0)
+
+
+class LayerDesc:
+    """Declarative layer for PipelineLayer stages.
+    Reference: fleet/meta_parallel/parallel_layers/pp_layers.py:LayerDesc."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr='weight',
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Holds the full stack of LayerDescs, partitioned into pp stages.
+
+    On TPU the stages all live in one program: paddle_tpu.parallel.pipeline
+    runs them as a shard_map over the 'pp' mesh axis with microbatch
+    rotation via ppermute (GPipe/1F1B schedules), instead of the reference's
+    per-process NCCL send/recv (fleet/meta_parallel/pipeline_parallel.py).
+    Eagerly (pp=1) it behaves as a Sequential.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method='uniform', recompute_interval=0, **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        topo = get_topology()
+        self.num_stages = num_stages or topo.axis_size('pp')
+        self.loss_fn = loss_fn
+        built = []
+        for d in self.descs:
+            built.append(d.build_layer() if isinstance(d, LayerDesc) else d)
+        from ...nn.layer_container import LayerList
+        self.run_function = LayerList(built)
+        # uniform partition of layers into stages
+        n = len(built)
+        per = -(-n // self.num_stages)
+        self.stage_bounds = [(i * per, min((i + 1) * per, n))
+                             for i in range(self.num_stages)]
+
+    def forward(self, x):
+        for l in self.run_function:
+            x = l(x)
+        return x
+
+    def stage_layers(self, stage):
+        lo, hi = self.stage_bounds[stage]
+        return list(self.run_function)[lo:hi]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
+class ShardingParallel(TensorParallel):
+    pass
+
+
+class PipelineParallel(TensorParallel):
+    pass

@@ -1,0 +1,47 @@
+"""DistributedStrategy. Reference:
+python/paddle/distributed/fleet/base/distributed_strategy.py (protobuf-backed
+toggle set). Here a plain config object whose toggles map onto mesh axes and
+jit options.
+"""
+
+
+class _Cfg(dict):
+    def __getattr__(self, k):
+        return self.get(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = _Cfg(init_loss_scaling=32768.0, use_pure_fp16=False,
+                                custom_white_list=[], custom_black_list=[])
+        self.recompute = False
+        self.recompute_configs = _Cfg(checkpoints=[])
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Cfg(k_steps=1, avg=True)
+        self.sharding = False
+        self.sharding_configs = _Cfg(sharding_degree=1, stage=2,
+                                     segment_broadcast_MB=32)
+        self.pipeline = False
+        self.pipeline_configs = _Cfg(accumulate_steps=1, micro_batch_size=1,
+                                     schedule_mode='1F1B')
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Cfg(tensor_parallel_degree=1)
+        self.hybrid_configs = _Cfg(dp_degree=1, mp_degree=1, pp_degree=1,
+                                   sharding_degree=1, sp_degree=1, ep_degree=1)
+        self.lamb = False
+        self.lars = False
+        self.localsgd = False
+        self.dgc = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.nccl_comm_num = 1
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f'DistributedStrategy(enabled={on}, hybrid={dict(self.hybrid_configs)})'

@@ -1,0 +1,67 @@
+"""Device-mesh topology for hybrid parallelism.
+
+Reference: python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology/HybridCommunicateGroup over NCCL groups). TPU-native: one
+``jax.sharding.Mesh`` with named axes — dp (data), sharding (ZeRO), pp
+(pipeline stage), mp (tensor/model), sp (sequence/context), ep (expert).
+Collectives ride ICI; XLA picks the routes. Axis order puts mp/sp innermost so
+their collectives use the fastest links (scaling-book recipe).
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+_AXIS_ORDER = ('pp', 'dp', 'sharding', 'ep', 'sp', 'mp')
+
+_current = None
+
+
+class HybridTopology:
+    def __init__(self, dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1, devices=None):
+        devices = devices if devices is not None else jax.devices()
+        degrees = {'dp': dp, 'mp': mp, 'pp': pp, 'sharding': sharding,
+                   'sp': sp, 'ep': ep}
+        need = int(np.prod(list(degrees.values())))
+        if need > len(devices):
+            raise ValueError(f'hybrid degrees {degrees} need {need} devices, '
+                             f'have {len(devices)}')
+        if need < len(devices):
+            # grow dp to cover all devices (paddle fleet default behavior)
+            if len(devices) % need == 0:
+                degrees['dp'] *= len(devices) // need
+                need = len(devices)
+        self.degrees = degrees
+        shape = [degrees[a] for a in _AXIS_ORDER]
+        dev_array = np.asarray(devices[:need]).reshape(shape)
+        self.mesh = Mesh(dev_array, _AXIS_ORDER)
+
+    def axis_size(self, name):
+        return self.degrees.get(name, 1)
+
+    def spec(self, *axes):
+        return PartitionSpec(*axes)
+
+    def sharding(self, *axes):
+        return NamedSharding(self.mesh, PartitionSpec(*axes))
+
+
+def set_topology(topo):
+    global _current
+    _current = topo
+    return topo
+
+
+def get_topology():
+    global _current
+    if _current is None:
+        _current = HybridTopology()
+    return _current
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+def replicated_sharding():
+    return NamedSharding(get_mesh(), PartitionSpec())

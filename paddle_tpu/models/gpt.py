@@ -1,0 +1,394 @@
+"""GPT-style causal LM — the flagship training model.
+
+Reference capability: PaddleNLP/Fleet GPT-3 hybrid parallel (the reference
+repo's fleet meta_parallel stack, e.g.
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py
+used by PaddleNLP gpt modeling). Re-designed TPU-first:
+
+  - functional core: params are a pytree with transformer blocks STACKED on a
+    leading layer dim and the forward a lax.scan over layers → one compiled
+    block body regardless of depth (fast compiles, XLA-friendly)
+  - bf16 activations/params option; fused QKV GEMM feeding the MXU
+  - attention: Pallas flash attention on TPU (paddle_tpu.ops), XLA softmax
+    fallback elsewhere
+  - parallelism: dp (batch), mp (Megatron-style column/row sharding expressed
+    as PartitionSpecs — XLA inserts the TP collectives), sp (ring attention
+    over the sequence axis via shard_map), pp (GPipe microbatch pipeline via
+    shard_map + ppermute), ZeRO opt-state sharding over dp
+  - jax.checkpoint (remat) per block for memory at scale
+
+The nn.Layer wrapper (GPTForCausalLM) exposes the paddle-style stateful API
+over the same functional core.
+"""
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_mult: int = 4
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: str = 'bfloat16'
+    param_dtype: str = 'float32'
+    remat: bool = True
+    use_flash: bool = True
+    # parallel degrees (must multiply to the mesh size together with dp)
+    mp: int = 1
+    pp: int = 1
+    sp: int = 1
+    n_microbatches: int = 1
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+
+def _split(key, n):
+    return jax.random.split(key, n)
+
+
+def init_params(config: GPTConfig, key):
+    """Stacked-block param pytree."""
+    h, f, v, L = (config.hidden_size, config.ffn_size, config.vocab_size,
+                  config.num_layers)
+    pdt = jnp.dtype(config.param_dtype)
+    k = iter(_split(key, 8))
+    std = 0.02
+
+    def nrm(kk, shape, scale=std):
+        return (scale * jax.random.normal(kk, shape)).astype(pdt)
+
+    kb = _split(next(k), 6)
+    blocks = {
+        'ln1_g': jnp.ones((L, h), pdt), 'ln1_b': jnp.zeros((L, h), pdt),
+        'qkv_w': nrm(kb[0], (L, h, 3 * h)), 'qkv_b': jnp.zeros((L, 3 * h), pdt),
+        'proj_w': nrm(kb[1], (L, h, h), std / math.sqrt(2 * L)),
+        'proj_b': jnp.zeros((L, h), pdt),
+        'ln2_g': jnp.ones((L, h), pdt), 'ln2_b': jnp.zeros((L, h), pdt),
+        'fc_w': nrm(kb[2], (L, h, f)), 'fc_b': jnp.zeros((L, f), pdt),
+        'out_w': nrm(kb[3], (L, f, h), std / math.sqrt(2 * L)),
+        'out_b': jnp.zeros((L, h), pdt),
+    }
+    return {
+        'wte': nrm(next(k), (v, h)),
+        'wpe': nrm(next(k), (config.max_seq_len, h), 0.01),
+        'blocks': blocks,
+        'lnf_g': jnp.ones((h,), pdt), 'lnf_b': jnp.zeros((h,), pdt),
+    }
+
+
+def param_specs(config: GPTConfig):
+    """Megatron-style PartitionSpecs: QKV/fc column-sharded, proj/out
+    row-sharded over 'mp'; blocks' leading layer dim sharded over 'pp'."""
+    pp = 'pp' if config.pp > 1 else None
+    blocks = {
+        'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
+        'qkv_w': P(pp, None, 'mp'), 'qkv_b': P(pp, 'mp'),
+        'proj_w': P(pp, 'mp', None), 'proj_b': P(pp, None),
+        'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
+        'fc_w': P(pp, None, 'mp'), 'fc_b': P(pp, 'mp'),
+        'out_w': P(pp, 'mp', None), 'out_b': P(pp, None),
+    }
+    return {'wte': P('mp', None), 'wpe': P(None, None), 'blocks': blocks,
+            'lnf_g': P(None), 'lnf_b': P(None)}
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def _attention(q, k, v, config, mesh=None):
+    """q/k/v: [B, S, H, D]."""
+    if config.sp > 1:
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis_name='sp', causal=True)
+    if config.use_flash:
+        try:
+            from ..ops.flash_attention import flash_attention, flash_attention_available
+            if flash_attention_available(q, k, v, None):
+                return flash_attention(q, k, v, causal=True)
+        except Exception:
+            pass
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    S = q.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v)
+
+
+def block_fn(bp, x, config, explicit_mp=False):
+    """One transformer block. bp: this layer's params (no leading L dim).
+    x: [B, S, H]. With ``explicit_mp`` (inside shard_map), qkv/fc weights are
+    the local 'mp' shards and the two row-parallel matmuls psum over 'mp' —
+    Megatron exactly as the reference's mp_layers, but via XLA collectives.
+    """
+    cdt = jnp.dtype(config.dtype)
+    B, S, h = x.shape
+    mp = config.mp if explicit_mp else 1
+    nh, hd = config.num_heads // mp, config.head_dim
+
+    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nh, hd)
+    v = v.reshape(B, S, nh, hd)
+    a = _attention(q, k, v, config).reshape(B, S, h // mp)
+    a = a @ bp['proj_w'].astype(cdt)
+    if mp > 1:
+        a = jax.lax.psum(a, 'mp')
+    x = x + a + bp['proj_b'].astype(cdt)
+
+    y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+    y = y @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt)
+    y = jax.nn.gelu(y)
+    y = y @ bp['out_w'].astype(cdt)
+    if mp > 1:
+        y = jax.lax.psum(y, 'mp')
+    x = x + y + bp['out_b'].astype(cdt)
+    return x
+
+
+def forward(params, tokens, config: GPTConfig):
+    """tokens: [B, S] int32 -> logits [B, S, V]. lax.scan over stacked blocks."""
+    cdt = jnp.dtype(config.dtype)
+    B, S = tokens.shape
+    pos = jnp.arange(S)
+    x = jnp.take(params['wte'], tokens, axis=0) + params['wpe'][pos]
+    x = x.astype(cdt)
+
+    body = partial(block_fn, config=config)
+    if config.remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(carry, bp):
+        return body(bp, carry), None
+
+    x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+    logits = x @ params['wte'].T.astype(cdt)
+    return logits
+
+
+def loss_fn(params, tokens, targets, config: GPTConfig):
+    logits = forward(params, tokens, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# Hybrid-parallel train step
+# ---------------------------------------------------------------------------
+
+def make_train_step(config: GPTConfig, optimizer, mesh=None):
+    """Returns jitted step(params, opt_state, key, lr, tokens, targets) ->
+    (loss, params, opt_state) sharded over the mesh. Shardings:
+      params per param_specs (mp/pp), batch over ('dp',), sequence over 'sp'
+      (ring attention), opt state ZeRO-sharded over dp when configured.
+    """
+    from ..distributed.topology import get_mesh
+    mesh = mesh or get_mesh()
+    specs = param_specs(config)
+
+    use_shard_map = config.sp > 1 or config.pp > 1
+
+    if not use_shard_map:
+        def step(params, opt_state, key, lr, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                      config)
+            new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
+            return loss, new_p, new_s
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # Explicit-collective path (shard_map over dp/sp/pp/mp): Megatron mp via
+    # psum in block_fn, ring attention over sp, GPipe microbatch over pp.
+    from jax.experimental.shard_map import shard_map
+    from ..parallel.pipeline import pipeline_apply, last_stage_mask
+
+    explicit_mp = config.mp > 1
+
+    def spmd_loss(params, tokens, targets):
+        cdt = jnp.dtype(config.dtype)
+        B, S = tokens.shape
+        sp_idx = jax.lax.axis_index('sp') if config.sp > 1 else 0
+        pos = sp_idx * S + jnp.arange(S)
+        x = jnp.take(params['wte'], tokens, axis=0) + params['wpe'][pos]
+        x = x.astype(cdt)
+
+        body = partial(block_fn, config=config, explicit_mp=explicit_mp)
+        if config.remat:
+            body = jax.checkpoint(body)
+
+        def scan_body(c, bp):
+            return body(bp, c), None
+
+        if config.pp > 1:
+            def stage_fn(stage_params, xx):
+                out, _ = jax.lax.scan(scan_body, xx, stage_params)
+                return out
+            x = pipeline_apply(stage_fn, params['blocks'], x,
+                               config.n_microbatches, axis_name='pp')
+        else:
+            x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+
+        x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+        logits = x @ params['wte'].T.astype(cdt)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll)
+        if config.pp > 1:
+            # head/loss are only valid on the last stage; mask + psum keeps
+            # both the value correct and the head grads un-duplicated
+            loss = jax.lax.psum(
+                jnp.where(last_stage_mask('pp'), loss, 0.0), 'pp')
+        loss = jax.lax.pmean(loss, 'dp')
+        if config.sp > 1:
+            loss = jax.lax.pmean(loss, 'sp')
+        return loss
+
+    pp, mp = ('pp' if config.pp > 1 else None), ('mp' if explicit_mp else None)
+    blocks_spec = {
+        'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
+        'qkv_w': P(pp, None, mp), 'qkv_b': P(pp, mp),
+        'proj_w': P(pp, mp, None), 'proj_b': P(pp, None),
+        'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
+        'fc_w': P(pp, None, mp), 'fc_b': P(pp, mp),
+        'out_w': P(pp, mp, None), 'out_b': P(pp, None),
+    }
+    pspec_tree = {'wte': P(None, None), 'wpe': P(None, None),
+                  'blocks': blocks_spec, 'lnf_g': P(None), 'lnf_b': P(None)}
+    data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
+
+    smapped = shard_map(spmd_loss, mesh=mesh,
+                        in_specs=(pspec_tree, data_spec, data_spec),
+                        out_specs=P(), check_rep=False)
+
+    def _fix_replicated_grads(grads):
+        """Params replicated across mp have their compute duplicated on every
+        mp rank; shard_map's backward sums replicas → rescale by 1/mp."""
+        if not explicit_mp:
+            return grads
+        inv = 1.0 / config.mp
+
+        def scale(g, spec):
+            has_mp = any((a == 'mp' or (isinstance(a, tuple) and 'mp' in a))
+                         for a in spec if a is not None)
+            return g if has_mp else g * inv
+        return jax.tree_util.tree_map(scale, grads, pspec_tree,
+                                      is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def step(params, opt_state, key, lr, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: smapped(p, tokens, targets))(params)
+        grads = _fix_replicated_grads(grads)
+        new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
+        return loss, new_p, new_s
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def train_specs(config: GPTConfig):
+    """PartitionSpecs matching what make_train_step expects for params."""
+    if config.sp > 1 or config.pp > 1:
+        pp = 'pp' if config.pp > 1 else None
+        mp = 'mp' if config.mp > 1 else None
+        blocks = {
+            'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
+            'qkv_w': P(pp, None, mp), 'qkv_b': P(pp, mp),
+            'proj_w': P(pp, mp, None), 'proj_b': P(pp, None),
+            'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
+            'fc_w': P(pp, None, mp), 'fc_b': P(pp, mp),
+            'out_w': P(pp, mp, None), 'out_b': P(pp, None),
+        }
+        return {'wte': P(None, None), 'wpe': P(None, None), 'blocks': blocks,
+                'lnf_g': P(None), 'lnf_b': P(None)}
+    return param_specs(config)
+
+
+def place_params(params, config, mesh):
+    specs = train_specs(config)
+
+    def put(x, s):
+        try:
+            return jax.device_put(x, NamedSharding(mesh, s))
+        except Exception:
+            return x
+    return jax.tree_util.tree_map(put, params, specs)
+
+
+# ---------------------------------------------------------------------------
+# Layer-API wrapper
+# ---------------------------------------------------------------------------
+
+class GPTForCausalLM(Layer):
+    """Stateful paddle-style wrapper over the functional core."""
+
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.config = config or GPTConfig(**kwargs)
+        from ..tensor.random import next_key
+        raw = init_params(self.config, next_key())
+        leaves, treedef = jax.tree_util.tree_flatten(raw)
+        self._treedef = treedef
+        self._n = len(leaves)
+        for i, leaf in enumerate(leaves):
+            self.add_parameter(f'p{i}', Parameter(leaf))
+
+    def _params(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [self._parameters[f'p{i}']._value
+                            for i in range(self._n)])
+
+    def forward(self, tokens):
+        from ..core.dispatch import apply_op
+        cfg = self.config
+        plist = [self._parameters[f'p{i}'] for i in range(self._n)]
+        treedef = self._treedef
+
+        def pure(tok, *leaves):
+            params = jax.tree_util.tree_unflatten(treedef, list(leaves))
+            return forward(params, jnp.asarray(tok).astype(jnp.int32), cfg)
+        return apply_op(pure, tokens, *plist)
+
+    def generate(self, tokens, max_new_tokens=32, temperature=1.0, top_k=None):
+        """Greedy/temperature sampling (eager loop, jitted forward)."""
+        from ..tensor.random import next_key
+        cfg = self.config
+        toks = tokens._value if isinstance(tokens, Tensor) else jnp.asarray(tokens)
+        toks = toks.astype(jnp.int32)
+        fwd = jax.jit(lambda p, t: forward(p, t, cfg))
+        for _ in range(max_new_tokens):
+            ctx = toks[:, -cfg.max_seq_len:]
+            logits = fwd(self._params(), ctx)[:, -1]
+            if temperature == 0:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                logits = logits / temperature
+                if top_k:
+                    kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                    logits = jnp.where(logits < kth, -jnp.inf, logits)
+                nxt = jax.random.categorical(next_key(), logits, axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)], axis=1)
+        return Tensor(toks)

@@ -1,0 +1,2 @@
+"""Custom TPU kernels (Pallas)."""
+from .flash_attention import flash_attention, flash_attention_available  # noqa: F401

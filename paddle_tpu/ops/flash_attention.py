@@ -1,0 +1,172 @@
+"""Pallas flash attention (TPU).
+
+The hot op of the transformer stack. Tiled online-softmax forward kernel:
+each grid program owns one query block in VMEM, streams key/value blocks,
+and never materializes the S×S score matrix in HBM (the reference's analogue
+is the fused CUDA attention in paddle/fluid/operators/fused/).
+
+Backward uses a blockwise jnp recompute (O(S·D) memory per block via scan)
+registered through jax.custom_vjp — functionally flash, XLA-scheduled.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:   # pragma: no cover
+    _HAS_PALLAS = False
+
+_BQ = 256
+_BK = 256
+
+
+def flash_attention_available(q, k, v, mask):
+    """Use the kernel for self-attention shapes that tile cleanly on TPU."""
+    if not _HAS_PALLAS or mask is not None:
+        return False
+    try:
+        dev = jax.devices()[0].platform.lower()
+    except Exception:
+        return False
+    if dev not in ('tpu', 'axon'):
+        return False
+    _, s_q, _, d = (int(x) for x in q.shape)
+    s_k = int(k.shape[1])
+    return (s_q == s_k and s_q % _BQ == 0 and s_k % _BK == 0 and
+            d in (64, 128, 256) and q.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    s_total = k_ref.shape[1]
+    nkb = s_total // bk
+    d = q.shape[-1]
+
+    def body(kb, carry):
+        acc, m, l = carry
+        kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)   # [BK, D]
+        vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [BQ,BK]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    n_iter = nkb if not causal else (qi + 1) * (bq // bk)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), -1e30, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))
+
+
+def _flash_fwd(q, k, v, causal):
+    """q/k/v: [BH, S, D] -> (out [BH,S,D], lse [BH,S])."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, s // _BQ)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               bq=_BQ, bk=_BK)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_blockwise(q, k, v, out, lse, g, causal):
+    """Blockwise gradients (scan over k-blocks), fp32 accumulation."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1)                      # [BH,S]
+
+    nkb = s // _BK
+    q_pos = jnp.arange(s)
+
+    def body(carry, kb):
+        dq = carry
+        sl = jax.lax.dynamic_slice_in_dim
+        kblk = sl(kf, kb * _BK, _BK, axis=1)               # [BH,BK,D]
+        vblk = sl(vf, kb * _BK, _BK, axis=1)
+        sc = jnp.einsum('bqd,bkd->bqk', qf, kblk)
+        if causal:
+            kp = kb * _BK + jnp.arange(_BK)
+            msk = q_pos[:, None] >= kp[None, :]
+            sc = jnp.where(msk[None], sc, -1e30)
+        p = jnp.exp(sc - lse[:, :, None])                  # [BH,S,BK]
+        dv = jnp.einsum('bqk,bqd->bkd', p, gf)
+        dp = jnp.einsum('bqd,bkd->bqk', gf, vblk)
+        ds = p * (dp - delta[:, :, None])
+        dq = dq + jnp.einsum('bqk,bkd->bqd', ds, kblk) * scale
+        dk = jnp.einsum('bqk,bqd->bkd', ds, qf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros((bh, s, d), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(nkb))
+    dk = dks.transpose(1, 0, 2, 3).reshape(bh, s, d)
+    dv = dvs.transpose(1, 0, 2, 3).reshape(bh, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    out, _ = _flash_fwd(q, k, v, causal)
+    return out
+
+
+def _flash_f(q, k, v, causal):
+    out, lse = _flash_fwd(q, k, v, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_b(causal, res, g):
+    q, k, v, out, lse = res
+    return _bwd_blockwise(q, k, v, out, lse, g, causal)
+
+
+_flash.defvjp(_flash_f, _flash_b)
+
+
+def flash_attention(q, k, v, causal=False):
+    """q/k/v: [B, S, H, D] (paddle layout) -> [B, S, H, D]."""
+    b, s, h, d = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out = _flash(qt, kt, vt, causal)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -147,23 +147,28 @@ class Optimizer:
 
     # ---- functional API for jitted train steps ---------------------------
     def functional_init(self, params):
-        """params: dict name -> array. Returns state pytree."""
-        return {k: self.init_state(v) for k, v in params.items()}
+        """params: arbitrary pytree of arrays. Returns a state pytree whose
+        leaves-per-param are this optimizer's state dicts."""
+        return jax.tree_util.tree_map(self.init_state, params)
 
     def functional_apply(self, params, grads, opt_state, lr=None):
-        """Pure: returns (new_params, new_state). Usable inside jit/pjit."""
+        """Pure: returns (new_params, new_state). Usable inside jit/pjit.
+        params/grads are matching pytrees; opt_state from functional_init."""
         lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_s = treedef.flatten_up_to(opt_state)
         if self._grad_clip is not None:
-            keys = list(grads.keys())
-            clipped = self._grad_clip.clip_arrays([grads[k] for k in keys])
-            grads = dict(zip(keys, clipped))
-        new_p, new_s = {}, {}
-        for k, p in params.items():
-            g = grads.get(k)
+            leaves_g = self._grad_clip.clip_arrays(leaves_g)
+        new_p, new_s = [], []
+        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
             if g is None:
-                new_p[k] = p
-                new_s[k] = opt_state[k]
+                new_p.append(p)
+                new_s.append(s)
                 continue
-            g = self._apply_decay(g, p)
-            new_p[k], new_s[k] = self._update(g, p, opt_state[k], lr)
-        return new_p, new_s
+            g = self._apply_decay(g.astype(p.dtype), p)
+            np_, ns_ = self._update(g, p, s, lr)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))

@@ -1,0 +1,77 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+Reference: paddle incubate MoE + Fleet alltoall
+(python/paddle/distributed/collective.py:alltoall). TPU-native: experts'
+weights carry a PartitionSpec with experts sharded over 'ep'; dispatch uses
+capacity-bucketed einsum routing (static shapes for XLA), and under pjit the
+token shuffle lowers to all-to-all on ICI.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+
+def top2_gating(logits, capacity):
+    """logits: [tokens, E]. Returns (combine [T,E,C], dispatch bool [T,E,C],
+    aux_loss). Static capacity → MXU-friendly einsum dispatch."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)                       # [T]
+    mask1 = jax.nn.one_hot(g1_idx, E, dtype=logits.dtype)
+    probs2 = probs * (1 - mask1)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(g2_idx, E, dtype=logits.dtype)
+
+    # aux load-balancing loss (Switch/GShard style)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    # positions within each expert (running count), capacity-clipped
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1          # [T,E]
+    mask1 = mask1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2 +
+            jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+    mask2 = mask2 * (pos2 < capacity)
+
+    w1 = jnp.sum(probs * mask1, axis=-1)                      # [T]
+    w2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    cap_oh1 = jax.nn.one_hot(jnp.sum(pos1, axis=-1).astype(jnp.int32),
+                             capacity, dtype=logits.dtype)    # [T,C]
+    cap_oh2 = jax.nn.one_hot(jnp.sum(pos2, axis=-1).astype(jnp.int32),
+                             capacity, dtype=logits.dtype)
+    combine = (w1[:, None, None] * mask1[:, :, None] * cap_oh1[:, None, :] +
+               w2[:, None, None] * mask2[:, :, None] * cap_oh2[:, None, :])
+    dispatch = combine > 0
+    return combine, dispatch, aux
+
+
+def moe_ffn(x, gate_w, w_in, w_out, capacity_factor=1.25, mesh_axes=True):
+    """x: [B, S, H]; gate_w: [H, E]; w_in: [E, H, F]; w_out: [E, F, H].
+    Returns (y, aux_loss). Under pjit, shard w_in/w_out with
+    PartitionSpec('ep', None, ...) and the dispatch einsum becomes a2a on ICI.
+    """
+    B, S, H = x.shape
+    E = gate_w.shape[1]
+    T = B * S
+    xt = x.reshape(T, H)
+    capacity = int(capacity_factor * T / E + 1)
+    logits = (xt @ gate_w).astype(jnp.float32)
+    combine, dispatch, aux = top2_gating(logits, capacity)
+    combine = combine.astype(x.dtype)
+    expert_in = jnp.einsum('tec,th->ech', dispatch.astype(x.dtype), xt)
+    h = jnp.einsum('ech,ehf->ecf', expert_in, w_in)
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum('ecf,efh->ech', h, w_out)
+    y = jnp.einsum('tec,ech->th', combine, expert_out)
+    return y.reshape(B, S, H), aux
+
+
+def expert_partition_specs():
+    return {'gate_w': PartitionSpec(None, None),
+            'w_in': PartitionSpec('ep', None, 'mp'),
+            'w_out': PartitionSpec('ep', 'mp', None)}

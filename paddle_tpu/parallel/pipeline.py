@@ -1,0 +1,80 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the 'pp' mesh
+axis using shard_map + ppermute.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+(1F1B over NCCL send/recv between stage processes). TPU-native: all stages
+live in ONE jitted program; stage params are stacked with a leading pp dim
+and sharded over 'pp'; activations rotate stage→stage via ppermute. XLA
+overlaps the permute with stage compute on ICI, and because the whole
+schedule is traced, backward runs the reverse pipeline automatically under
+jax.grad — no hand-written 1F1B bookkeeping.
+
+The stage function must be uniform across stages (same jaxpr): standard
+stacked-transformer-block setup.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn, stage_params, x, n_microbatches, axis_name='pp'):
+    """Run microbatched pipeline inside shard_map.
+
+    stage_fn(params, x) -> y          one stage's computation (uniform)
+    stage_params: this device's stage params (leading pp dim already split)
+    x: [B, ...] local full batch (same on every stage; only stage 0's input
+       matters — later stages receive rotated activations)
+    Returns y: [B, ...] valid on the LAST stage (others carry garbage).
+    """
+    pp = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    B = x.shape[0]
+    assert B % n_microbatches == 0
+    mb = B // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    n_steps = n_microbatches + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def body(carry, t):
+        state, outputs = carry
+        # which microbatch enters stage 0 at step t
+        feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+        inject = micro[feed_idx]
+        cur_in = jnp.where(stage == 0, inject, state)
+        out = stage_fn(stage_params, cur_in)
+        # last stage writes its finished microbatch t - (pp - 1)
+        done_idx = t - (pp - 1)
+        write = jnp.logical_and(stage == pp - 1, done_idx >= 0)
+        outputs = jax.lax.cond(
+            write,
+            lambda o: o.at[jnp.clip(done_idx, 0, n_microbatches - 1)].set(out),
+            lambda o: o, outputs)
+        # rotate activations to the next stage
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    outputs0 = jnp.zeros((n_microbatches, mb) + x.shape[1:], x.dtype)
+    (state, outputs), _ = jax.lax.scan(body, (state0, outputs0),
+                                       jnp.arange(n_steps))
+    y = outputs.reshape((B,) + x.shape[1:])
+    # y is valid ONLY on the last stage. Callers must mask their loss with
+    # ``last_stage_mask`` and psum over the axis — broadcasting y here would
+    # duplicate the loss-head compute across stages and overcount its grads.
+    return y
+
+
+def last_stage_mask(axis_name='pp'):
+    pp = jax.lax.psum(1, axis_name)
+    return jax.lax.axis_index(axis_name) == pp - 1
+
+
+def stack_stage_params(per_layer_params, n_stages):
+    """[L, ...] stacked per-layer params -> [pp, L/pp, ...] for 'pp' sharding."""
+    def reshape(x):
+        L = x.shape[0]
+        assert L % n_stages == 0
+        return x.reshape((n_stages, L // n_stages) + x.shape[1:])
+    return jax.tree_util.tree_map(reshape, per_layer_params)

@@ -1,0 +1,86 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context / context-parallel engine (reference analogue: sequence-parallel
+NCCL p2p in fleet meta_parallel + RingFlashAttention-style kernels). Each
+device holds a query block [B, S/sp, H, D]; K/V blocks rotate around the 'sp'
+ring via ppermute while a running softmax (flash-attention style m/l
+accumulators) merges partial results — attention memory stays O(S/sp) per
+chip and the permutes overlap with the block matmuls on ICI.
+
+Pure function over arrays: call inside shard_map with axis 'sp'.
+"""
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, mask_val, scale):
+    """One block: returns (unnormalized out, running max m, running sum l)."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    s = s + mask_val
+    m = jnp.max(s, axis=-1)                       # [B,H,Q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                       # [B,H,Q]
+    o = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name='sp', causal=True):
+    """q/k/v: [B, S_local, H, D] (the 'sp'-local sequence shard).
+
+    Returns [B, S_local, H, D]. Exact softmax over the full sequence.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    q32 = q.astype(jnp.float32)
+
+    def mask_for(kv_rank):
+        if not causal:
+            return jnp.zeros((1, 1, S, S), jnp.float32)
+        q_pos = idx * S + jnp.arange(S)[:, None]          # [S,1]
+        k_pos = kv_rank * S + jnp.arange(S)[None, :]      # [1,S]
+        return jnp.where(q_pos >= k_pos, 0.0, neg)[None, None]
+
+    def body(carry, _):
+        o_acc, m_acc, l_acc, k_cur, v_cur, kv_rank = carry
+        mask = mask_for(kv_rank)
+        o_b, m_b, l_b = _block_attn(q32, k_cur.astype(jnp.float32),
+                                    v_cur.astype(jnp.float32), mask, scale)
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        o_acc = o_acc * alpha.transpose(0, 2, 1)[..., None] + \
+            o_b * beta.transpose(0, 2, 1)[..., None]
+        l_acc = l_acc * alpha + l_b * beta
+        # rotate K/V to the next rank on the ring (overlaps with next block)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_rank = (kv_rank - 1) % sp
+        return (o_acc, m_new, l_acc, k_nxt, v_nxt, kv_rank), None
+
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v, idx), None, length=sp)
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, mesh, causal=True):
+    """shard_map wrapper: q/k/v are [B, S, H, D] global arrays; runs ring
+    attention with S sharded over the mesh 'sp' axis."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    spec = P(('dp',), 'sp', None, None)
+    f = shard_map(partial(ring_attention, axis_name='sp', causal=causal),
+                  mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                  check_rep=False)
+    return f(q, k, v)
