@@ -6,6 +6,13 @@ Reference for API parity: /root/reference python/paddle/__init__.py (v2.1).
 """
 __version__ = '0.1.0'
 
+import jax as _jax
+
+# Paddle's default integer dtype is int64; JAX needs x64 enabled for that.
+# Float defaults remain float32 everywhere (creation ops force it), so TPU
+# perf is unaffected; bf16 comes from amp / model dtype configs.
+_jax.config.update('jax_enable_x64', True)
+
 from .core.dtype import (  # noqa: F401
     bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
     float64, complex64, complex128)

@@ -1,0 +1,20 @@
+"""Test config: 8 virtual CPU devices so distributed tests run anywhere."""
+import os
+
+os.environ.setdefault('XLA_FLAGS',
+                      '--xla_force_host_platform_device_count=8')
+os.environ['JAX_PLATFORM_NAME'] = 'cpu'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(42)
+    yield

@@ -1,0 +1,102 @@
+"""Autograd: tape grads vs jax.grad of equivalent pure functions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor(np.array([1., 2., 3.], 'float32'), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_chain():
+    a = np.random.rand(4).astype('float32')
+    x = paddle.to_tensor(a, stop_gradient=False)
+    z = paddle.exp(paddle.sin(x)).mean()
+    z.backward()
+    ref = jax.grad(lambda v: jnp.mean(jnp.exp(jnp.sin(v))))(a)
+    assert np.allclose(x.grad.numpy(), np.asarray(ref), rtol=1e-5)
+
+
+def test_accumulation_and_clear():
+    x = paddle.to_tensor(np.ones(3, 'float32'), stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    assert np.allclose(x.grad.numpy(), 5.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor(np.ones(3, 'float32'), stop_gradient=False)
+    with paddle.no_grad():
+        y = (x * 2).sum()
+    assert y._node is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor(np.ones(3, 'float32'), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(3, 'float32'))  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    assert x.grad is not None and y.grad is None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.ones(3, 'float32'), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert np.allclose(x.grad.numpy(), 4.0)
+
+
+def test_double_backward_error():
+    x = paddle.to_tensor(np.ones(3, 'float32'), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    try:
+        y.backward()
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array([2., 3.], 'float32'), stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x)
+    assert np.allclose(gx.numpy(), 3 * x.numpy() ** 2)
+
+
+def test_matmul_grad():
+    a = np.random.rand(3, 4).astype('float32')
+    b = np.random.rand(4, 2).astype('float32')
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.matmul(ta, tb).sum()
+    loss.backward()
+    ga, gb = jax.grad(lambda x, y: jnp.sum(x @ y), argnums=(0, 1))(a, b)
+    assert np.allclose(ta.grad.numpy(), np.asarray(ga), rtol=1e-5)
+    assert np.allclose(tb.grad.numpy(), np.asarray(gb), rtol=1e-5)
+
+
+def test_multi_output_op_grad():
+    a = np.random.rand(6).astype('float32')
+    x = paddle.to_tensor(a, stop_gradient=False)
+    s = paddle.split(x, 3)
+    (s[0] * 2 + s[2]).sum().backward()
+    assert np.allclose(x.grad.numpy(), np.array([2, 2, 0, 0, 1, 1], 'float32'))
+
+
+def test_getitem_grad():
+    a = np.random.rand(4, 3).astype('float32')
+    x = paddle.to_tensor(a, stop_gradient=False)
+    x[1:3].sum().backward()
+    expect = np.zeros_like(a)
+    expect[1:3] = 1
+    assert np.allclose(x.grad.numpy(), expect)

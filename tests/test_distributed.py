@@ -1,0 +1,172 @@
+"""Distributed: hybrid parallel on the 8-device virtual CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import gpt
+
+
+def _mk(cfg_kw, strat_kw):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = strat_kw
+    topo = fleet.init(is_collective=True, strategy=strategy)
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=4, max_seq_len=32, dtype='float32',
+                        use_flash=False, remat=False, **cfg_kw)
+    return topo, cfg
+
+
+def _ref_loss(params, toks, cfg):
+    ref_cfg = gpt.GPTConfig(vocab_size=cfg.vocab_size,
+                            hidden_size=cfg.hidden_size,
+                            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                            max_seq_len=cfg.max_seq_len, dtype='float32',
+                            use_flash=False, remat=False)
+    return float(gpt.loss_fn(params, toks, toks, ref_cfg))
+
+
+def test_mesh_axes():
+    topo, _ = _mk({}, {'dp_degree': 8})
+    assert dict(topo.mesh.shape)['dp'] == 8
+
+
+def test_dp_training_decreases_loss():
+    topo, cfg = _mk({}, {'dp_degree': 8})
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    losses = []
+    key = jax.random.PRNGKey(2)
+    for i in range(3):
+        loss, params, opt_state = step(params, opt_state, key,
+                                       jnp.asarray(1e-3), toks, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_mp_matches_single_device():
+    topo, cfg = _mk({'mp': 4, 'sp': 1, 'pp': 1},
+                    {'dp_degree': 2, 'mp_degree': 4})
+    # mp>1 only triggers explicit path when sp/pp>1; use pp=1,sp=1 + mp via
+    # shard_map requires use_shard_map — force by sp=1? mp alone uses GSPMD
+    # path (jit). Verify loss equality there.
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    ref = _ref_loss(params, toks, cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.0)
+    placed = gpt.place_params(params, cfg, topo.mesh)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(placed)
+    loss, _, _ = step(placed, opt_state, jax.random.PRNGKey(2),
+                      jnp.asarray(0.0), toks, toks)
+    assert abs(float(loss) - ref) < 1e-3
+
+
+def test_pp_matches_single_device():
+    topo, cfg = _mk({'pp': 4, 'n_microbatches': 2},
+                    {'dp_degree': 2, 'pp_degree': 4})
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    ref = _ref_loss(params, toks, cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.0)
+    placed = gpt.place_params(params, cfg, topo.mesh)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(placed)
+    loss, _, _ = step(placed, opt_state, jax.random.PRNGKey(2),
+                      jnp.asarray(0.0), toks, toks)
+    assert abs(float(loss) - ref) < 1e-3
+
+
+def test_sp_ring_attention_matches():
+    topo, cfg = _mk({'sp': 4}, {'dp_degree': 2, 'sp_degree': 4})
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+    ref = _ref_loss(params, toks, cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.0)
+    placed = gpt.place_params(params, cfg, topo.mesh)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(placed)
+    loss, _, _ = step(placed, opt_state, jax.random.PRNGKey(2),
+                      jnp.asarray(0.0), toks, toks)
+    assert abs(float(loss) - ref) < 1e-3
+
+
+def test_full_hybrid_trains():
+    topo, cfg = _mk({'mp': 2, 'pp': 2, 'n_microbatches': 2},
+                    {'dp_degree': 2, 'mp_degree': 2, 'pp_degree': 2})
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    placed = gpt.place_params(params, cfg, topo.mesh)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(placed)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    l0, placed, opt_state = step(placed, opt_state, jax.random.PRNGKey(2),
+                                 jnp.asarray(1e-3), toks, toks)
+    l1, placed, opt_state = step(placed, opt_state, jax.random.PRNGKey(3),
+                                 jnp.asarray(1e-3), toks, toks)
+    assert float(l1) < float(l0)
+
+
+def test_pp_grads_match_single_device():
+    """Pipeline-parallel grads == sequential grads (catches overcounting)."""
+    topo, cfg = _mk({'pp': 2, 'n_microbatches': 2},
+                    {'pp_degree': 2})
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    ref_cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                            num_heads=4, max_seq_len=32, dtype='float32',
+                            use_flash=False, remat=False)
+    ref_grads = jax.grad(gpt.loss_fn)(params, toks, toks, ref_cfg)
+
+    wte0 = np.asarray(params['wte']).copy()
+    qkv0 = np.asarray(params['blocks']['qkv_w']).copy()
+    opt = paddle.optimizer.SGD(learning_rate=1.0)
+    placed = gpt.place_params(params, cfg, topo.mesh)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    opt_state = opt.functional_init(placed)
+    _, new_params, _ = step(placed, opt_state, jax.random.PRNGKey(2),
+                            jnp.asarray(1.0), toks, toks)
+    # with SGD lr=1: new = old - grad -> grad = old - new
+    got_wte = wte0 - np.asarray(new_params['wte'])
+    assert np.allclose(got_wte, np.asarray(ref_grads['wte']), atol=1e-4)
+    got_qkv = qkv0 - np.asarray(new_params['blocks']['qkv_w'])
+    assert np.allclose(got_qkv, np.asarray(ref_grads['blocks']['qkv_w']),
+                       atol=1e-4)
+
+
+def test_collectives_eager_identity():
+    import paddle_tpu.distributed as dist
+    x = paddle.to_tensor(np.array([1., 2.], 'float32'))
+    dist.all_reduce(x)
+    assert np.allclose(x.numpy(), [1., 2.])
+    assert dist.get_world_size() == 1
+
+
+def test_moe_dispatch():
+    from paddle_tpu.parallel.moe import moe_ffn
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2, 8, 16))
+    gate_w = jax.random.normal(jax.random.PRNGKey(1), (16, 4)) * 0.1
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 32)) * 0.1
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (4, 32, 16)) * 0.1
+    y, aux = moe_ffn(x, gate_w, w_in, w_out)
+    assert y.shape == (2, 8, 16)
+    assert float(aux) > 0
+
+
+def test_zero_sharded_opt_state():
+    topo, cfg = _mk({}, {'dp_degree': 8})
+    strategy = fleet.get_strategy()
+    strategy.sharding = True
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), strategy)
+    state = opt.functional_init({'w': jnp.zeros((64, 32))})
+    m1 = state['w']['moment1']
+    # sharded over dp: each shard holds 1/8 of rows
+    assert m1.sharding is not None

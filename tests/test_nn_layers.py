@@ -1,0 +1,174 @@
+"""Layer behavior vs references."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = lin(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    assert np.allclose(y.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shapes():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.Conv2D(3, 6, 3)(x).shape == [2, 6, 6, 6]
+    assert nn.Conv2D(3, 6, 3, padding=1)(x).shape == [2, 6, 8, 8]
+    assert nn.Conv2D(3, 6, 3, stride=2, padding=1)(x).shape == [2, 6, 4, 4]
+    assert nn.Conv2D(3, 3, 3, padding=1, groups=3)(x).shape == [2, 3, 8, 8]
+    assert nn.Conv2DTranspose(3, 6, 2, stride=2)(x).shape == [2, 6, 16, 16]
+    xn = paddle.randn([2, 8, 8, 3])
+    assert nn.Conv2D(3, 6, 3, data_format='NHWC')(xn).shape == [2, 6, 6, 6]
+
+
+def test_conv2d_value():
+    # identity kernel check
+    x = paddle.randn([1, 1, 5, 5])
+    conv = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+    w = np.zeros((1, 1, 3, 3), 'float32')
+    w[0, 0, 1, 1] = 1.0
+    conv.weight.set_value(w)
+    assert np.allclose(conv(x).numpy(), x.numpy(), atol=1e-6)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3 + 2
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    assert np.allclose(m, 0, atol=1e-4)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8]) * 5 + 3
+    y = ln(x).numpy()
+    assert np.allclose(y.mean(-1), 0, atol=1e-4)
+    assert np.allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_groupnorm_instancenorm():
+    x = paddle.randn([2, 4, 6, 6])
+    assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 6, 6]
+    assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 6, 6]
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    a = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    out = nn.MaxPool2D(2)(paddle.to_tensor(a)).numpy()
+    assert np.allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_embedding_dropout():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]], 'int64'))
+    assert emb(idx).shape == [2, 2, 4]
+    do = nn.Dropout(0.5)
+    do.eval()
+    x = paddle.ones([10, 10])
+    assert np.allclose(do(x).numpy(), 1.0)
+    do.train()
+    y = do(x).numpy()
+    assert set(np.unique(y)).issubset({0.0, 2.0})
+
+
+def test_activations():
+    x = paddle.to_tensor(np.array([-2., 0., 2.], 'float32'))
+    assert np.allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    assert np.allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp([2., 0., -2.])),
+                       rtol=1e-5)
+    assert np.allclose(F.softmax(x).numpy().sum(), 1, rtol=1e-5)
+    assert np.allclose(nn.LeakyReLU(0.1)(x).numpy(), [-0.2, 0, 2], rtol=1e-5)
+    assert np.allclose(F.gelu(paddle.zeros([1])).numpy(), 0)
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.array([[2., 1., 0.1]], 'float32'))
+    label = paddle.to_tensor(np.array([0], 'int64'))
+    ce = nn.CrossEntropyLoss()(logits, label)
+    ref = -np.log(np.exp(2) / np.exp([2, 1, 0.1]).sum())
+    assert np.allclose(ce.numpy(), ref, rtol=1e-5)
+    a = paddle.to_tensor(np.array([1., 2.], 'float32'))
+    b = paddle.to_tensor(np.array([1.5, 2.5], 'float32'))
+    assert np.allclose(nn.MSELoss()(a, b).numpy(), 0.25)
+    assert np.allclose(nn.L1Loss()(a, b).numpy(), 0.5)
+    p = paddle.to_tensor(np.array([0.8, 0.3], 'float32'))
+    t_ = paddle.to_tensor(np.array([1., 0.], 'float32'))
+    ref_bce = -(np.log(0.8) + np.log(0.7)) / 2
+    assert np.allclose(nn.BCELoss()(p, t_).numpy(), ref_bce, rtol=1e-5)
+
+
+def test_containers_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(m.parameters()) == 4
+    sd = m.state_dict()
+    assert len(sd) == 4
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    x = paddle.randn([2, 4])
+    assert np.allclose(m(x).numpy(), m2(x).numpy())
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3 and len(ll.parameters()) == 6
+
+
+def test_rnn_cells_and_layers():
+    cell = nn.LSTMCell(4, 8)
+    x = paddle.randn([2, 4])
+    y, (h, c) = cell(x)
+    assert y.shape == [2, 8] and h.shape == [2, 8]
+    gru = nn.GRU(4, 8, num_layers=1)
+    out, h = gru(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 8] and h.shape == [1, 2, 8]
+
+
+def test_transformer_shapes():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 6, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 4, 16]
+
+
+def test_mha_grad():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    x.stop_gradient = False
+    mha(x).sum().backward()
+    assert x.grad is not None
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_clip_grad():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    import jax.numpy as jnp
+    clip = ClipGradByGlobalNorm(1.0)
+    gs = clip.clip_arrays([jnp.ones((10,)) * 10])
+    assert np.allclose(np.linalg.norm(np.asarray(gs[0])), 1.0, rtol=1e-4)
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    lin = nn.Linear(4, 3)
+    ref = lin(paddle.ones([1, 4])).numpy()
+    weight_norm(lin)
+    out = lin(paddle.ones([1, 4])).numpy()
+    assert np.allclose(out, ref, rtol=1e-4)
+    assert 'weight_g' in dict(lin.named_parameters())
+    remove_weight_norm(lin)
+    assert np.allclose(lin(paddle.ones([1, 4])).numpy(), ref, rtol=1e-4)

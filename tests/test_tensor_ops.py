@@ -1,0 +1,161 @@
+"""Op parity vs numpy (mirrors the reference's per-op unittests,
+python/paddle/fluid/tests/unittests/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def test_creation():
+    assert paddle.ones([2, 3]).shape == [2, 3]
+    assert paddle.zeros([4]).numpy().sum() == 0
+    assert paddle.full([2, 2], 7).numpy().tolist() == [[7, 7], [7, 7]]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert np.allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    assert paddle.eye(3).numpy().trace() == 3
+    x = paddle.to_tensor([[1., 2.], [3., 4.]])
+    assert np.allclose(paddle.tril(x).numpy(), np.tril(x.numpy()))
+    assert np.allclose(paddle.ones_like(x).numpy(), 1)
+
+
+def test_elementwise_math():
+    a = np.random.rand(3, 4).astype('float32') + 0.5
+    b = np.random.rand(3, 4).astype('float32') + 0.5
+    for name, ref in [('add', np.add), ('subtract', np.subtract),
+                      ('multiply', np.multiply), ('divide', np.divide),
+                      ('maximum', np.maximum), ('minimum', np.minimum),
+                      ('pow', np.power)]:
+        out = getattr(paddle, name)(t(a), t(b)).numpy()
+        assert np.allclose(out, ref(a, b), rtol=1e-5), name
+    for name, ref in [('exp', np.exp), ('log', np.log), ('sqrt', np.sqrt),
+                      ('abs', np.abs), ('sin', np.sin), ('cos', np.cos),
+                      ('tanh', np.tanh), ('floor', np.floor), ('ceil', np.ceil),
+                      ('square', np.square), ('sign', np.sign)]:
+        out = getattr(paddle, name)(t(a)).numpy()
+        assert np.allclose(out, ref(a), rtol=1e-5, atol=1e-6), name
+
+
+def test_reductions():
+    a = np.random.rand(3, 4, 5).astype('float32')
+    assert np.allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+    assert np.allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+    assert np.allclose(paddle.mean(t(a), axis=[0, 2]).numpy(), a.mean((0, 2)), rtol=1e-5)
+    assert np.allclose(paddle.max(t(a), axis=2, keepdim=True).numpy(),
+                       a.max(2, keepdims=True))
+    assert np.allclose(paddle.prod(t(a), axis=0).numpy(), a.prod(0), rtol=1e-4)
+    assert np.allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+    assert np.allclose(paddle.var(t(a), unbiased=False).numpy(), a.var(), rtol=1e-4)
+    assert np.allclose(paddle.median(t(np.arange(10).astype('float32'))).numpy(), 4.5)
+    assert np.allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+    assert np.allclose(paddle.logsumexp(t(a), axis=1).numpy(),
+                       np.log(np.exp(a).sum(1)), rtol=1e-5)
+
+
+def test_matmul_linalg():
+    a = np.random.rand(3, 4).astype('float32')
+    b = np.random.rand(4, 5).astype('float32')
+    assert np.allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+    assert np.allclose(paddle.matmul(t(a), t(a), transpose_y=True).numpy(),
+                       a @ a.T, rtol=1e-5)
+    assert np.allclose(paddle.einsum('ij,jk->ik', t(a), t(b)).numpy(), a @ b,
+                       rtol=1e-5)
+    sq = np.random.rand(4, 4).astype('float32') + 2 * np.eye(4, dtype='float32')
+    assert np.allclose(paddle.linalg.inverse(t(sq)).numpy(), np.linalg.inv(sq),
+                       rtol=1e-3, atol=1e-4)
+    assert np.allclose(paddle.linalg.det(t(sq)).numpy(), np.linalg.det(sq),
+                       rtol=1e-4)
+    assert np.allclose(paddle.linalg.norm(t(a)).numpy(),
+                       np.linalg.norm(a), rtol=1e-5)
+    assert np.allclose(paddle.t(a).T.numpy() if False else paddle.to_tensor(a).T.numpy(),
+                       a.T)
+
+
+def test_manipulation():
+    a = np.random.rand(2, 3, 4).astype('float32')
+    assert paddle.reshape(t(a), [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t(a), [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t(a), 1).shape == [2, 12]
+    assert paddle.squeeze(t(a[None]), 0).shape == [2, 3, 4]
+    assert paddle.unsqueeze(t(a), 1).shape == [2, 1, 3, 4]
+    c = paddle.concat([t(a), t(a)], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.split(t(a), 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    st = paddle.stack([t(a), t(a)], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    assert paddle.tile(t(a), [1, 2, 1]).shape == [2, 6, 4]
+    assert np.allclose(paddle.flip(t(a), [1]).numpy(), a[:, ::-1])
+    assert np.allclose(paddle.roll(t(a), 1, axis=0).numpy(), np.roll(a, 1, 0))
+    g = paddle.gather(t(a), t([0, 1]), axis=1)
+    assert g.shape == [2, 2, 4]
+    assert paddle.chunk(t(a), 2, axis=2)[0].shape == [2, 3, 2]
+    assert np.allclose(paddle.cast(t(a), 'int32').numpy(), a.astype('int32'))
+
+
+def test_indexing_and_search():
+    a = np.random.rand(4, 5).astype('float32')
+    x = t(a)
+    assert np.allclose(x[1].numpy(), a[1])
+    assert np.allclose(x[:, 2:4].numpy(), a[:, 2:4])
+    assert np.allclose(paddle.argmax(x, axis=1).numpy(), a.argmax(1))
+    assert np.allclose(paddle.argsort(x, axis=1).numpy(), a.argsort(1))
+    assert np.allclose(paddle.sort(x, axis=1).numpy(), np.sort(a, 1))
+    vals, idx = paddle.topk(x, 2, axis=1)
+    ref = np.sort(a, 1)[:, ::-1][:, :2]
+    assert np.allclose(vals.numpy(), ref, rtol=1e-6)
+    w = paddle.where(x > 0.5, x, paddle.zeros_like(x))
+    assert np.allclose(w.numpy(), np.where(a > 0.5, a, 0))
+    nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+    assert nz.numpy().tolist() == [[1], [3]]
+
+
+def test_logic():
+    a = np.array([1., 2., 3.], 'float32')
+    b = np.array([1., 5., 3.], 'float32')
+    assert paddle.equal(t(a), t(b)).numpy().tolist() == [True, False, True]
+    assert bool(paddle.equal_all(t(a), t(a)).numpy())
+    assert bool(paddle.allclose(t(a), t(a + 1e-9)).numpy())
+    assert paddle.logical_and(t([True, False]), t([True, True])).numpy().tolist() == [True, False]
+
+
+def test_random_and_stats():
+    paddle.seed(1)
+    r = paddle.rand([1000])
+    assert 0.4 < float(r.mean()) < 0.6
+    rn = paddle.randn([1000])
+    assert abs(float(rn.mean())) < 0.2
+    ri = paddle.randint(0, 10, [100])
+    assert int(ri.max()) < 10 and int(ri.min()) >= 0
+    rp = paddle.randperm(10)
+    assert sorted(rp.numpy().tolist()) == list(range(10))
+    m = paddle.multinomial(t(np.array([0.1, 0.0, 0.9], 'float32')), 50,
+                           replacement=True)
+    assert 1 not in m.numpy()
+
+
+def test_operators_and_methods():
+    a = t(np.array([2., 4.], 'float32'))
+    b = t(np.array([1., 2.], 'float32'))
+    assert (a + b).numpy().tolist() == [3., 6.]
+    assert (a - b).numpy().tolist() == [1., 2.]
+    assert (a * b).numpy().tolist() == [2., 8.]
+    assert (a / b).numpy().tolist() == [2., 2.]
+    assert (a ** 2).numpy().tolist() == [4., 16.]
+    assert (-a).numpy().tolist() == [-2., -4.]
+    assert (a > b).numpy().tolist() == [True, True]
+    assert (1 + a).numpy().tolist() == [3., 5.]
+    assert a.add(b).numpy().tolist() == [3., 6.]
+    assert a.astype('int64').dtype.name == 'int64'
+    assert a.numel().item() == 2
+
+
+def test_fft():
+    x = np.random.rand(8).astype('float32')
+    out = paddle.fft.fft(t(x)).numpy()
+    assert np.allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-5)
+    out2 = paddle.fft.rfft(t(x)).numpy()
+    assert np.allclose(out2, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
