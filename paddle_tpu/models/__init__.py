@@ -1,0 +1,6 @@
+"""Flagship model families (training-scale, TPU-first functional cores)."""
+from . import gpt  # noqa: F401
+from . import ernie  # noqa: F401
+from . import moe_gpt  # noqa: F401
+from .crnn import CRNN  # noqa: F401
+from .ppyolo_lite import PPYOLOELite  # noqa: F401

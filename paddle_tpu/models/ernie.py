@@ -1,0 +1,186 @@
+"""ERNIE/BERT-style masked-LM encoder for pretraining.
+
+Reference capability: ERNIE/BERT pretrain with Fleet dp+sharding (reference
+repo's fleet stack; model family from PaddleNLP ernie). TPU-first design like
+models/gpt.py: stacked-block functional core under lax.scan, bf16 compute,
+flash attention (bidirectional), dp/sharding via pjit.
+"""
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+
+
+@dataclasses.dataclass
+class ErnieConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_mult: int = 4
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: str = 'bfloat16'
+    param_dtype: str = 'float32'
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+
+def init_params(config: ErnieConfig, key):
+    h, f, v, L = (config.hidden_size, config.ffn_size, config.vocab_size,
+                  config.num_layers)
+    pdt = jnp.dtype(config.param_dtype)
+    ks = jax.random.split(key, 10)
+    std = 0.02
+
+    def nrm(kk, shape, scale=std):
+        return (scale * jax.random.normal(kk, shape)).astype(pdt)
+
+    blocks = {
+        'qkv_w': nrm(ks[0], (L, h, 3 * h)), 'qkv_b': jnp.zeros((L, 3 * h), pdt),
+        'proj_w': nrm(ks[1], (L, h, h)), 'proj_b': jnp.zeros((L, h), pdt),
+        'ln1_g': jnp.ones((L, h), pdt), 'ln1_b': jnp.zeros((L, h), pdt),
+        'fc_w': nrm(ks[2], (L, h, f)), 'fc_b': jnp.zeros((L, f), pdt),
+        'out_w': nrm(ks[3], (L, f, h)), 'out_b': jnp.zeros((L, h), pdt),
+        'ln2_g': jnp.ones((L, h), pdt), 'ln2_b': jnp.zeros((L, h), pdt),
+    }
+    return {
+        'wte': nrm(ks[4], (v, h)),
+        'wpe': nrm(ks[5], (config.max_seq_len, h)),
+        'wtype': nrm(ks[6], (config.type_vocab_size, h)),
+        'emb_ln_g': jnp.ones((h,), pdt), 'emb_ln_b': jnp.zeros((h,), pdt),
+        'blocks': blocks,
+        'pool_w': nrm(ks[7], (h, h)), 'pool_b': jnp.zeros((h,), pdt),
+        'mlm_w': nrm(ks[8], (h, h)), 'mlm_b': jnp.zeros((h,), pdt),
+        'mlm_ln_g': jnp.ones((h,), pdt), 'mlm_ln_b': jnp.zeros((h,), pdt),
+        'nsp_w': nrm(ks[9], (h, 2)), 'nsp_b': jnp.zeros((2,), pdt),
+    }
+
+
+def _ln(x, g, b, eps=1e-12):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _block(bp, x, mask_bias, config):
+    cdt = jnp.dtype(config.dtype)
+    B, S, h = x.shape
+    nh, hd = config.num_heads, config.head_dim
+    qkv = x @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nh, hd)
+    v = v.reshape(B, S, nh, hd)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) / math.sqrt(hd)
+    s = s + mask_bias
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cdt)
+    a = jnp.einsum('bhqk,bkhd->bqhd', p, v).reshape(B, S, h)
+    x = _ln(x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt),
+            bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    y = jax.nn.gelu(x @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt))
+    y = y @ bp['out_w'].astype(cdt) + bp['out_b'].astype(cdt)
+    return _ln(x + y, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+
+
+def encode(params, tokens, token_type=None, attn_mask=None, config=None):
+    cdt = jnp.dtype(config.dtype)
+    B, S = tokens.shape
+    tt = token_type if token_type is not None else jnp.zeros_like(tokens)
+    x = (jnp.take(params['wte'], tokens, axis=0) +
+         params['wpe'][jnp.arange(S)] +
+         jnp.take(params['wtype'], tt, axis=0))
+    x = _ln(x, params['emb_ln_g'], params['emb_ln_b']).astype(cdt)
+    if attn_mask is not None:
+        bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e30).astype(cdt)
+    else:
+        bias = jnp.zeros((B, 1, 1, S), cdt)
+
+    body = partial(_block, mask_bias=bias, config=config)
+    if config.remat:
+        body = jax.checkpoint(lambda bp, xx: body(bp, xx))
+
+    def scan_body(c, bp):
+        return body(bp, c), None
+    x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+    return x
+
+
+def pretrain_loss(params, tokens, token_type, attn_mask, mlm_labels,
+                  nsp_labels, config):
+    """Masked-LM + next-sentence losses (BERT pretraining objective).
+    mlm_labels: -100 where not predicted."""
+    h = encode(params, tokens, token_type, attn_mask, config)
+    cdt = h.dtype
+    # MLM head
+    mh = jax.nn.gelu(h @ params['mlm_w'].astype(cdt) + params['mlm_b'].astype(cdt))
+    mh = _ln(mh, params['mlm_ln_g'], params['mlm_ln_b']).astype(cdt)
+    logits = mh @ params['wte'].T.astype(cdt)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = mlm_labels >= 0
+    ll = jnp.take_along_axis(logp, jnp.maximum(mlm_labels, 0)[..., None],
+                             axis=-1)[..., 0]
+    mlm_loss = -jnp.sum(jnp.where(valid, ll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1)
+    # NSP head on [CLS]
+    pooled = jnp.tanh(h[:, 0] @ params['pool_w'].astype(cdt) +
+                      params['pool_b'].astype(cdt))
+    nsp_logits = pooled @ params['nsp_w'].astype(cdt) + params['nsp_b'].astype(cdt)
+    nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
+    nsp_loss = -jnp.mean(jnp.take_along_axis(nsp_logp, nsp_labels[:, None],
+                                             axis=-1))
+    return mlm_loss + nsp_loss
+
+
+class ErnieModel(Layer):
+    """Stateful wrapper (sequence classification-ready)."""
+
+    def __init__(self, config: ErnieConfig = None, **kwargs):
+        super().__init__()
+        self.config = config or ErnieConfig(**kwargs)
+        from ..tensor.random import next_key
+        raw = init_params(self.config, next_key())
+        leaves, treedef = jax.tree_util.tree_flatten(raw)
+        self._treedef = treedef
+        self._n = len(leaves)
+        for i, leaf in enumerate(leaves):
+            self.add_parameter(f'p{i}', Parameter(leaf))
+
+    def _params(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [self._parameters[f'p{i}']._value
+                            for i in range(self._n)])
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        from ..core.dispatch import apply_op
+        cfg = self.config
+        treedef = self._treedef
+        plist = [self._parameters[f'p{i}'] for i in range(self._n)]
+        tt = token_type_ids
+        am = attention_mask
+
+        def pure(tok, *leaves):
+            params = jax.tree_util.tree_unflatten(treedef, list(leaves))
+            tok = jnp.asarray(tok).astype(jnp.int32)
+            ttv = (jnp.asarray(tt._value if isinstance(tt, Tensor) else tt)
+                   .astype(jnp.int32) if tt is not None else None)
+            amv = (jnp.asarray(am._value if isinstance(am, Tensor) else am)
+                   if am is not None else None)
+            h = encode(params, tok, ttv, amv, cfg)
+            cdt = h.dtype
+            pooled = jnp.tanh(h[:, 0] @ params['pool_w'].astype(cdt) +
+                              params['pool_b'].astype(cdt))
+            return h, pooled
+        return apply_op(pure, input_ids, *plist)

@@ -1,0 +1,144 @@
+"""Mixtral-style MoE causal LM with expert parallelism.
+
+Reference capability: Fleet MoE expert-parallel via alltoall over NCCL
+(python/paddle/distributed/collective.py:alltoall + incubate MoE layers).
+TPU-first: experts sharded over the 'ep' mesh axis via GSPMD — the capacity-
+bucketed dispatch einsums (paddle_tpu.parallel.moe) lower to all-to-all on
+ICI automatically from the shardings.
+"""
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.moe import moe_ffn
+from .gpt import _layer_norm, _attention
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    n_experts: int = 8
+    ffn_mult: int = 4
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    max_seq_len: int = 1024
+    dtype: str = 'bfloat16'
+    param_dtype: str = 'float32'
+    remat: bool = True
+    use_flash: bool = True
+    sp: int = 1
+    mp: int = 1
+    pp: int = 1
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+
+def init_params(config: MoEConfig, key):
+    h, f, v, L, E = (config.hidden_size, config.ffn_size, config.vocab_size,
+                     config.num_layers, config.n_experts)
+    pdt = jnp.dtype(config.param_dtype)
+    ks = jax.random.split(key, 8)
+    std = 0.02
+
+    def nrm(kk, shape, scale=std):
+        return (scale * jax.random.normal(kk, shape)).astype(pdt)
+
+    blocks = {
+        'ln1_g': jnp.ones((L, h), pdt), 'ln1_b': jnp.zeros((L, h), pdt),
+        'qkv_w': nrm(ks[0], (L, h, 3 * h)), 'qkv_b': jnp.zeros((L, 3 * h), pdt),
+        'proj_w': nrm(ks[1], (L, h, h)), 'proj_b': jnp.zeros((L, h), pdt),
+        'ln2_g': jnp.ones((L, h), pdt), 'ln2_b': jnp.zeros((L, h), pdt),
+        'gate_w': nrm(ks[2], (L, h, E), 0.01),
+        'w_in': nrm(ks[3], (L, E, h, f)),
+        'w_out': nrm(ks[4], (L, E, f, h)),
+    }
+    return {'wte': nrm(ks[5], (v, h)), 'wpe': nrm(ks[6], (config.max_seq_len, h), 0.01),
+            'blocks': blocks, 'lnf_g': jnp.ones((h,), pdt),
+            'lnf_b': jnp.zeros((h,), pdt)}
+
+
+def param_specs(config: MoEConfig):
+    """Experts sharded over 'ep'; dense weights replicated (mp optional)."""
+    blocks = {
+        'ln1_g': P(), 'ln1_b': P(),
+        'qkv_w': P(None, None, 'mp'), 'qkv_b': P(None, 'mp'),
+        'proj_w': P(None, 'mp', None), 'proj_b': P(),
+        'ln2_g': P(), 'ln2_b': P(),
+        'gate_w': P(), 'w_in': P(None, 'ep', None, 'mp'),
+        'w_out': P(None, 'ep', 'mp', None),
+    }
+    return {'wte': P('mp', None), 'wpe': P(), 'blocks': blocks,
+            'lnf_g': P(), 'lnf_b': P()}
+
+
+def block_fn(bp, carry, config):
+    x, aux_acc = carry
+    cdt = jnp.dtype(config.dtype)
+    B, S, h = x.shape
+    nh, hd = config.num_heads, config.head_dim
+    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    a = _attention(q.reshape(B, S, nh, hd), k.reshape(B, S, nh, hd),
+                   v.reshape(B, S, nh, hd), config).reshape(B, S, h)
+    x = x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt)
+    y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+    ff, aux = moe_ffn(y, bp['gate_w'].astype(cdt),
+                      bp['w_in'].astype(cdt), bp['w_out'].astype(cdt),
+                      capacity_factor=config.capacity_factor)
+    return (x + ff, aux_acc + aux), None
+
+
+def forward(params, tokens, config):
+    cdt = jnp.dtype(config.dtype)
+    B, S = tokens.shape
+    x = (jnp.take(params['wte'], tokens, axis=0) +
+         params['wpe'][jnp.arange(S)]).astype(cdt)
+    body = partial(block_fn, config=config)
+    if config.remat:
+        body = jax.checkpoint(body)
+    (x, aux), _ = jax.lax.scan(lambda c, bp: body(bp, c), (x, jnp.zeros((), jnp.float32)),
+                               params['blocks'])
+    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+    return x @ params['wte'].T.astype(cdt), aux
+
+
+def loss_fn(params, tokens, targets, config):
+    logits, aux = forward(params, tokens, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + config.aux_weight * aux / config.num_layers
+
+
+def make_train_step(config, optimizer, mesh=None):
+    from ..distributed.topology import get_mesh
+    mesh = mesh or get_mesh()
+
+    def step(params, opt_state, key, lr, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, config)
+        new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
+        return loss, new_p, new_s
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def place_params(params, config, mesh):
+    specs = param_specs(config)
+
+    def put(x, s):
+        try:
+            return jax.device_put(x, NamedSharding(mesh, s))
+        except Exception:
+            return x
+    return jax.tree_util.tree_map(put, params, specs)

@@ -1,0 +1,71 @@
+"""PP-YOLOE-lite-class single-stage detector: CSP-ish backbone + FPN-lite +
+decoupled YOLO head, decoded by paddle_tpu.vision.ops.yolo_box + nms.
+
+Reference capability: PP-YOLOE served through Paddle Inference static graphs.
+"""
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor.manipulation import concat
+from paddle_tpu.nn.functional import interpolate
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k=3, s=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=s, padding=k // 2,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.Silu()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class CSPBlock(nn.Layer):
+    def __init__(self, c, n=1):
+        super().__init__()
+        self.cv1 = ConvBNAct(c, c // 2, 1)
+        self.cv2 = ConvBNAct(c, c // 2, 1)
+        self.m = nn.Sequential(*[ConvBNAct(c // 2, c // 2) for _ in range(n)])
+        self.cv3 = ConvBNAct(c, c, 1)
+
+    def forward(self, x):
+        return self.cv3(concat([self.m(self.cv1(x)), self.cv2(x)], axis=1))
+
+
+class PPYOLOELite(nn.Layer):
+    def __init__(self, num_classes=80, width=32, num_anchors=3):
+        super().__init__()
+        w = width
+        self.num_classes = num_classes
+        self.num_anchors = num_anchors
+        self.stem = ConvBNAct(3, w, 3, 2)                       # /2
+        self.c2 = nn.Sequential(ConvBNAct(w, w * 2, 3, 2), CSPBlock(w * 2))    # /4
+        self.c3 = nn.Sequential(ConvBNAct(w * 2, w * 4, 3, 2), CSPBlock(w * 4))  # /8
+        self.c4 = nn.Sequential(ConvBNAct(w * 4, w * 8, 3, 2), CSPBlock(w * 8))  # /16
+        self.c5 = nn.Sequential(ConvBNAct(w * 8, w * 16, 3, 2), CSPBlock(w * 16))  # /32
+        self.lat5 = ConvBNAct(w * 16, w * 8, 1)
+        self.lat4 = ConvBNAct(w * 16, w * 4, 1)
+        out_ch = num_anchors * (5 + num_classes)
+        self.head32 = nn.Conv2D(w * 8, out_ch, 1)
+        self.head16 = nn.Conv2D(w * 4, out_ch, 1)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.c2(x)
+        c3 = self.c3(x)
+        c4 = self.c4(c3)
+        c5 = self.c5(c4)
+        p5 = self.lat5(c5)
+        up = interpolate(p5, scale_factor=2, mode='nearest')
+        p4 = self.lat4(concat([up, c4], axis=1))
+        return self.head32(p5), self.head16(p4)
+
+    def decode(self, outs, img_size, conf_thresh=0.25):
+        from paddle_tpu.vision.ops import yolo_box
+        anchors32 = [116, 90, 156, 198, 373, 326]
+        anchors16 = [30, 61, 62, 45, 59, 119]
+        b32, s32 = yolo_box(outs[0], img_size, anchors32, self.num_classes,
+                            conf_thresh, downsample_ratio=32)
+        b16, s16 = yolo_box(outs[1], img_size, anchors16, self.num_classes,
+                            conf_thresh, downsample_ratio=16)
+        return concat([b32, b16], axis=1), concat([s32, s16], axis=1)

@@ -195,8 +195,8 @@ def upsample(x, size=None, scale_factor=None, mode='nearest',
 def affine_grid(theta, out_shape, align_corners=True, name=None):
     n, c, h, w = [int(s) for s in out_shape]
     if align_corners:
-        ys = jnp.linspace(-1, 1, h)
-        xs = jnp.linspace(-1, 1, w)
+        ys = jnp.linspace(-1, 1, h, dtype=theta.dtype)
+        xs = jnp.linspace(-1, 1, w, dtype=theta.dtype)
     else:
         ys = (jnp.arange(h) * 2 + 1) / h - 1
         xs = (jnp.arange(w) * 2 + 1) / w - 1

@@ -34,13 +34,16 @@ def _pool(x, kind, kernel, stride, padding, nd, data_format, ceil_mode=False,
     if isinstance(pad, str):
         pad_cfg = pad
     if kind == 'max':
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        init = (jnp.asarray(-jnp.inf, x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype))
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pad_cfg)
     # avg
-    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pad_cfg)
+    zero = jnp.asarray(0, x.dtype)
+    summed = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides, pad_cfg)
     if exclusive and not count_include_pad and not isinstance(pad_cfg, str):
         ones = jnp.ones_like(x)
-        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+        counts = jax.lax.reduce_window(ones, zero, jax.lax.add, window, strides, pad_cfg)
         return summed / counts
     denom = 1
     for k in kernel:

@@ -20,8 +20,8 @@ class _BatchNormBase(Layer):
         self.weight = self.create_parameter(
             (num_features,), weight_attr, default_initializer=I.Constant(1.0))
         self.bias = self.create_parameter((num_features,), bias_attr, is_bias=True)
-        self.register_buffer('_mean', Tensor(jnp.zeros((num_features,))))
-        self.register_buffer('_variance', Tensor(jnp.ones((num_features,))))
+        self.register_buffer('_mean', Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer('_variance', Tensor(jnp.ones((num_features,), jnp.float32)))
         self._mesh_axis = None   # set by SyncBatchNorm / parallel wrappers
 
     def forward(self, x):

@@ -1,0 +1,212 @@
+"""Vision datasets. Reference: python/paddle/vision/datasets/.
+
+No-egress environment: loaders read local files when present (same formats as
+the reference: MNIST idx, CIFAR pickle tars, folder trees) and otherwise fall
+back to deterministic synthetic data (mode='synthetic') so training/test
+pipelines run anywhere.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+DATA_HOME = os.path.expanduser(os.environ.get('PADDLE_TPU_DATA_HOME',
+                                              '~/.cache/paddle_tpu/datasets'))
+
+
+def _synthetic_images(n, shape, n_classes, seed):
+    rng = np.random.RandomState(seed)
+    imgs = (rng.rand(n, *shape) * 255).astype('uint8')
+    labels = rng.randint(0, n_classes, (n,)).astype('int64')
+    return imgs, labels
+
+
+class MNIST(Dataset):
+    """MNIST idx files if available, else synthetic."""
+
+    def __init__(self, image_path=None, label_path=None, mode='train',
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        images = labels = None
+        base = os.path.join(DATA_HOME, 'mnist')
+        prefix = 'train' if mode == 'train' else 't10k'
+        ip = image_path or os.path.join(base, f'{prefix}-images-idx3-ubyte.gz')
+        lp = label_path or os.path.join(base, f'{prefix}-labels-idx1-ubyte.gz')
+        if os.path.exists(ip) and os.path.exists(lp):
+            with gzip.open(ip, 'rb') as f:
+                magic, n, rows, cols = struct.unpack('>IIII', f.read(16))
+                images = np.frombuffer(f.read(), 'uint8').reshape(n, rows, cols)
+            with gzip.open(lp, 'rb') as f:
+                struct.unpack('>II', f.read(8))
+                labels = np.frombuffer(f.read(), 'uint8').astype('int64')
+        else:
+            n = 1024 if mode == 'train' else 256
+            images, labels = _synthetic_images(n, (28, 28), 10, 0)
+        self.images = images
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype('float32')[..., None]
+        label = np.asarray([self.labels[idx]], 'int64')
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            DATA_HOME, f'cifar-{self.N_CLASSES}-python.tar.gz')
+        if os.path.exists(data_file):
+            self.data = self._load_tar(data_file, mode)
+        else:
+            n = 1024 if mode == 'train' else 256
+            imgs, labels = _synthetic_images(n, (3, 32, 32), self.N_CLASSES, 1)
+            self.data = list(zip(imgs.reshape(n, -1), labels))
+
+    def _load_tar(self, path, mode):
+        out = []
+        want = 'data_batch' if mode == 'train' else 'test_batch'
+        if self.N_CLASSES == 100:
+            want = 'train' if mode == 'train' else 'test'
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if want in m.name:
+                    d = pickle.load(tf.extractfile(m), encoding='bytes')
+                    key = b'labels' if b'labels' in d else b'fine_labels'
+                    out.extend(zip(d[b'data'], d[key]))
+        return out
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        img = np.asarray(img).reshape(3, 32, 32).transpose(1, 2, 0).astype('float32')
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, 'int64')
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    N_CLASSES = 100
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode='train', transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 256 if mode == 'train' else 64
+        self.images, self.labels = _synthetic_images(n, (64, 64, 3), 102, 2)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype('float32')
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], 'int64')
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 64
+        rng = np.random.RandomState(3)
+        self.images = (rng.rand(n, 3, 64, 64) * 255).astype('uint8')
+        self.masks = rng.randint(0, 21, (n, 64, 64)).astype('int64')
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype('float32')
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.npy')
+
+
+class DatasetFolder(Dataset):
+    """Folder-of-class-folders loader (reference: vision/datasets/folder.py).
+    Supports .npy images natively; PIL formats when Pillow is installed."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith('.npy'):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert('RGB'))
+        except ImportError as e:
+            raise ImportError('Pillow needed for non-.npy images') from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

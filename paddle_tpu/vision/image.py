@@ -1,0 +1,26 @@
+"""Image backend helpers. Reference: python/paddle/vision/image.py."""
+import numpy as np
+
+_backend = 'tensor'
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in ('pil', 'cv2', 'tensor'):
+        raise ValueError(f'unsupported backend {backend}')
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend
+
+
+def image_load(path, backend=None):
+    if path.endswith('.npy'):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return Image.open(path)
+    except ImportError as e:
+        raise ImportError('Pillow required for non-.npy images '
+                          '(offline env: use .npy)') from e

@@ -1,0 +1,187 @@
+"""Vision ops: nms, roi_align, yolo_box, box coding, deform_conv2d (gated).
+
+Reference: python/paddle/vision/ops.py (C++ kernels in
+paddle/fluid/operators/detection/). TPU-native: static-shape jnp
+implementations (nms via fixed-iteration suppression loop).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op, apply_op
+from ..core.tensor import Tensor
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Returns kept indices sorted by score. Static-shape inner loop, numpy
+    boundary (eager op, matching the reference API which returns indices)."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes)
+    n = b.shape[0]
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else
+                   (scores if scores is not None else np.ones(n, 'float32')))
+    if category_idxs is not None:
+        cat = np.asarray(category_idxs._value
+                         if isinstance(category_idxs, Tensor) else category_idxs)
+        # offset boxes per category so cross-category boxes never overlap
+        offset = cat.astype('float32') * (b.max() + 1.0)
+        b = b + offset[:, None]
+    order = np.argsort(-s)
+    iou = np.asarray(_iou_matrix(jnp.asarray(b[order])))
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in range(n):
+        if suppressed[i]:
+            continue
+        keep.append(order[i])
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = False
+    keep = np.asarray(keep, 'int64')
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+@op
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """x: [N,C,H,W]; boxes: [R,4] in (x1,y1,x2,y2); boxes_num: [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    boxes_num = jnp.asarray(boxes_num)
+    box_batch = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=R)
+
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_h = rh / oh
+    bin_w = rw / ow
+
+    ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * bin_h[:, None]  # [R,oh]
+    xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * bin_w[:, None]  # [R,ow]
+
+    def bilinear(feat, yy, xx):
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_, x1_ = y0 + 1, x0 + 1
+        wy1 = yy - y0
+        wx1 = xx - x0
+        y0c = jnp.clip(y0, 0, H - 1)
+        y1c = jnp.clip(y1_, 0, H - 1)
+        x0c = jnp.clip(x0, 0, W - 1)
+        x1c = jnp.clip(x1_, 0, W - 1)
+        v00 = feat[:, y0c, :][:, :, x0c]
+        v01 = feat[:, y0c, :][:, :, x1c]
+        v10 = feat[:, y1c, :][:, :, x0c]
+        v11 = feat[:, y1c, :][:, :, x1c]
+        return (v00 * (1 - wy1)[None, :, None] * (1 - wx1)[None, None, :] +
+                v01 * (1 - wy1)[None, :, None] * wx1[None, None, :] +
+                v10 * wy1[None, :, None] * (1 - wx1)[None, None, :] +
+                v11 * wy1[None, :, None] * wx1[None, None, :])
+
+    def one_roi(r):
+        feat = x[box_batch[r]]                   # [C,H,W]
+        return bilinear(feat, ys[r], xs[r])      # [C,oh,ow]
+
+    return jax.vmap(one_roi)(jnp.arange(R))
+
+
+@op
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio=32,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """x: [N, na*(5+cls), H, W] -> (boxes [N, na*H*W, 4], scores [N, na*H*W, cls])."""
+    N, _, H, W = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    pred = jnp.reshape(x, (N, na, 5 + class_num, H, W))
+    gx = jnp.arange(W)[None, None, None, :]
+    gy = jnp.arange(H)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(pred[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / W
+    by = (sig(pred[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / H
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / (W * downsample_ratio)
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / (H * downsample_ratio)
+    conf = sig(pred[:, :, 4])
+    probs = sig(pred[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] > conf_thresh, probs, 0.0)
+    imgs = jnp.asarray(img_size, jnp.float32).reshape(N, 2)
+    ih, iw = imgs[:, 0], imgs[:, 1]
+    x1 = (bx - bw / 2) * iw[:, None, None, None]
+    y1 = (by - bh / 2) * ih[:, None, None, None]
+    x2 = (bx + bw / 2) * iw[:, None, None, None]
+    y2 = (by + bh / 2) * ih[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, ih[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, iw[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, ih[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(N, -1, class_num)
+    return boxes, scores
+
+
+@op
+def box_coder(prior_box, prior_box_var, target_box, code_type='encode_center_size',
+              box_normalized=True, axis=0):
+    pb = prior_box
+    pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+    ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    var = prior_box_var if prior_box_var is not None else jnp.ones_like(pb)
+    if code_type == 'encode_center_size':
+        tw = target_box[:, 2] - target_box[:, 0] + (0 if box_normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + (0 if box_normalized else 1)
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / var[None, :, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+    # decode_center_size, axis=0 layout [N, M, 4]
+    t = target_box
+    dw = jnp.exp(var[None, :, 2] * t[:, :, 2]) * pw[None, :]
+    dh = jnp.exp(var[None, :, 3] * t[:, :, 3]) * ph[None, :]
+    dcx = var[None, :, 0] * t[:, :, 0] * pw[None, :] + px[None, :]
+    dcy = var[None, :, 1] * t[:, :, 1] * ph[None, :] + py[None, :]
+    x1 = dcx - dw * 0.5
+    y1 = dcy - dh * 0.5
+    x2 = dcx + dw * 0.5 - (0 if box_normalized else 1)
+    y2 = dcy + dh * 0.5 - (0 if box_normalized else 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    raise NotImplementedError('psroi_pool: planned (round 2)')
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 via grid_sample gather (compile-friendly)."""
+    from ..nn.functional.common import grid_sample  # noqa — future use
+    raise NotImplementedError('deform_conv2d: planned (round 2)')
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError('DeformConv2D: planned (round 2)')

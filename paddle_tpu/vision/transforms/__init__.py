@@ -1,0 +1,6 @@
+from .transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
+    RandomHorizontalFlip, RandomResizedCrop, RandomRotation, RandomVerticalFlip,
+    Resize, SaturationTransform, ToTensor, Transpose)
+from . import functional  # noqa: F401

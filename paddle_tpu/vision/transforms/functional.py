@@ -1,0 +1,163 @@
+"""Transform functionals on numpy HWC arrays (no PIL/cv2 dependency — the
+'tensor' backend of the reference, python/paddle/vision/transforms/functional_tensor.py)."""
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format='CHW'):
+    arr = _np(pic).astype('float32')
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == 'CHW' and arr.ndim == 3:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def resize(img, size, interpolation='bilinear'):
+    import jax
+    import jax.numpy as jnp
+    arr = _np(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    method = {'bilinear': 'bilinear', 'nearest': 'nearest',
+              'bicubic': 'bicubic'}.get(interpolation, 'bilinear')
+    out_shape = (nh, nw) + arr.shape[2:]
+    return np.asarray(jax.image.resize(jnp.asarray(arr), out_shape, method))
+
+
+def crop(img, top, left, height, width):
+    return _np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return crop(arr, i, j, th, tw)
+
+
+def hflip(img):
+    return _np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _np(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode='constant'):
+    arr = _np(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    cfg = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {'constant': 'constant', 'edge': 'edge', 'reflect': 'reflect',
+            'symmetric': 'symmetric'}[padding_mode]
+    if mode == 'constant':
+        return np.pad(arr, cfg, mode=mode, constant_values=fill)
+    return np.pad(arr, cfg, mode=mode)
+
+
+def rotate(img, angle, interpolation='nearest', expand=False, center=None,
+           fill=0):
+    arr = _np(img)
+    k = int(round(angle / 90.0)) % 4
+    if abs(angle - 90 * round(angle / 90.0)) < 1e-6:
+        return np.rot90(arr, k).copy()
+    # arbitrary-angle nearest rotation
+    h, w = arr.shape[:2]
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    theta = np.deg2rad(angle)
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = cy + (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta)
+    xs = cx + (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta)
+    ysc = np.clip(np.round(ys).astype(int), 0, h - 1)
+    xsc = np.clip(np.round(xs).astype(int), 0, w - 1)
+    out = arr[ysc, xsc]
+    mask = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+    out[mask] = fill
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _np(img).astype('float32')
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi).astype(_np(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _np(img).astype('float32')
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    mean = arr.mean()
+    return np.clip(mean + contrast_factor * (arr - mean), 0, hi).astype(_np(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _np(img).astype('float32')
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    gray = arr.mean(axis=-1, keepdims=True)
+    return np.clip(gray + saturation_factor * (arr - gray), 0, hi).astype(_np(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    arr = _np(img).astype('float32')
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    x = arr / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    deltac = maxc - minc
+    s = np.where(maxc > 0, deltac / np.maximum(maxc, 1e-12), 0)
+    dc = np.maximum(deltac, 1e-12)
+    rc, gc, bc = (maxc - r) / dc, (maxc - g) / dc, (maxc - b) / dc
+    h = np.where(r == maxc, bc - gc, np.where(g == maxc, 2 + rc - bc, 4 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6).astype(int)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = i % 6
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return (out * scale).astype(_np(img).dtype)
+
+
+def normalize(img, mean, std, data_format='CHW', to_rgb=False):
+    arr = _np(img).astype('float32')
+    mean = np.asarray(mean, 'float32')
+    std = np.asarray(std, 'float32')
+    if data_format == 'CHW':
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _np(img).astype('float32')
+    gray = (0.2989 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2])
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray.astype(_np(img).dtype)

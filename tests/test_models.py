@@ -1,0 +1,134 @@
+"""Model-zoo forward + one train step (loss decreases)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_lenet_trains():
+    from paddle_tpu.vision.models import LeNet
+    m = LeNet()
+    x = paddle.randn([4, 1, 28, 28])
+    y = paddle.to_tensor(np.random.randint(0, 10, (4,)).astype('int64'))
+    opt = paddle.optimizer.Adam(1e-3, parameters=m.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(3):
+        loss = loss_fn(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_resnet18_forward():
+    from paddle_tpu.vision.models import resnet18
+    m = resnet18(num_classes=10)
+    m.eval()
+    out = m(paddle.randn([2, 3, 64, 64]))
+    assert out.shape == [2, 10]
+
+
+def test_mobilenet_forward():
+    from paddle_tpu.vision.models import mobilenet_v2
+    m = mobilenet_v2(num_classes=7, scale=0.5)
+    m.eval()
+    assert m(paddle.randn([1, 3, 64, 64])).shape == [1, 7]
+
+
+def test_vgg_forward():
+    from paddle_tpu.vision.models import vgg11
+    m = vgg11(num_classes=5)
+    m.eval()
+    assert m(paddle.randn([1, 3, 224, 224])).shape == [1, 5]
+
+
+def test_gpt_generate():
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=50, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dtype='float32',
+                        use_flash=False, remat=False)
+    m = gpt.GPTForCausalLM(cfg)
+    toks = paddle.to_tensor(np.array([[1, 2, 3]], 'int64'))
+    out = m.generate(toks, max_new_tokens=4, temperature=0)
+    assert out.shape == [1, 7]
+
+
+def test_ernie_pretrain_loss_decreases():
+    from paddle_tpu.models import ernie
+    cfg = ernie.ErnieConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=32, dtype='float32',
+                            remat=False)
+    params = ernie.init_params(cfg, jax.random.PRNGKey(0))
+    B, S = 2, 16
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, 100)
+    tt = jnp.zeros((B, S), jnp.int32)
+    am = jnp.ones((B, S), jnp.int32)
+    mlm = jnp.where(jax.random.uniform(jax.random.PRNGKey(2), (B, S)) < 0.15,
+                    toks, -100)
+    nsp = jnp.zeros((B,), jnp.int32)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-3)
+    state = opt.functional_init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(ernie.pretrain_loss)(
+            params, toks, tt, am, mlm, nsp, cfg)
+        p2, s2 = opt.functional_apply(params, g, state, jnp.asarray(1e-3))
+        return loss, p2, s2
+
+    l0, params, state = step(params, state)
+    l1, params, state = step(params, state)
+    l2, params, state = step(params, state)
+    assert float(l2) < float(l0)
+
+
+def test_moe_gpt_trains():
+    from paddle_tpu.models import moe_gpt
+    cfg = moe_gpt.MoEConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=2, n_experts=4, max_seq_len=32,
+                            dtype='float32', remat=False, use_flash=False)
+    params = moe_gpt.init_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    state = opt.functional_init(params)
+    step = moe_gpt.make_train_step(cfg, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    l0, params, state = step(params, state, jax.random.PRNGKey(2),
+                             jnp.asarray(1e-3), toks, toks)
+    l1, params, state = step(params, state, jax.random.PRNGKey(3),
+                             jnp.asarray(1e-3), toks, toks)
+    assert float(l1) < float(l0)
+
+
+def test_crnn_ctc():
+    from paddle_tpu.models import CRNN
+    m = CRNN(num_classes=11)
+    x = paddle.randn([2, 1, 32, 64])
+    logits = m(x)               # [2, 16, 11]
+    assert logits.shape == [2, 16, 11]
+    from paddle_tpu.tensor.manipulation import transpose
+    lp = transpose(logits, [1, 0, 2])
+    labels = paddle.to_tensor(np.random.randint(1, 11, (2, 5)).astype('int64'))
+    loss = nn.CTCLoss()(lp, labels,
+                        paddle.to_tensor(np.array([16, 16], 'int64')),
+                        paddle.to_tensor(np.array([5, 5], 'int64')))
+    assert np.isfinite(float(loss))
+    loss.backward()
+
+
+def test_ppyolo_lite_decode():
+    from paddle_tpu.models import PPYOLOELite
+    m = PPYOLOELite(num_classes=4, width=8)
+    m.eval()
+    x = paddle.randn([1, 3, 64, 64])
+    outs = m(x)
+    assert outs[0].shape[2] == 2 and outs[1].shape[2] == 4
+    boxes, scores = m.decode(outs, paddle.to_tensor(np.array([[64, 64]], 'int64')))
+    assert boxes.shape[-1] == 4 and scores.shape[-1] == 4
+    from paddle_tpu.vision.ops import nms
+    keep = nms(boxes[0], 0.5, scores[0].max(axis=-1))
+    assert keep.ndim == 1
