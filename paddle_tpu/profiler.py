@@ -1,0 +1,109 @@
+"""Profiler. Reference: python/paddle/fluid/profiler.py + new paddle.profiler.
+
+TPU-native: wraps jax.profiler — traces go to TensorBoard-compatible
+protobufs; RecordEvent maps to jax.profiler.TraceAnnotation.
+"""
+import contextlib
+import time
+
+import jax
+
+
+class ProfilerTarget:
+    CPU = 'cpu'
+    GPU = 'gpu'
+    TPU = 'tpu'
+
+
+class RecordEvent:
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir='./profiler_log'):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._started = False
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        if not self.timer_only:
+            try:
+                jax.profiler.start_trace(self.log_dir)
+            except Exception:
+                self.timer_only = True
+        self._started = True
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+
+    def stop(self):
+        if self._started and not self.timer_only:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        self._started = False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit='ms'):
+        if not self._step_times:
+            return 'no steps recorded'
+        import numpy as np
+        ts = np.asarray(self._step_times) * 1000
+        return (f'steps={len(ts)} mean={ts.mean():.2f}ms p50='
+                f'{np.percentile(ts, 50):.2f}ms p99={np.percentile(ts, 99):.2f}ms')
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
+    p = Profiler(timer_only=False, log_dir=profile_path)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def start_profiler(state='All', tracer_option='Default'):
+    jax.profiler.start_trace('./profiler_log')
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass

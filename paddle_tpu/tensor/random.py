@@ -13,7 +13,8 @@ from ..core.tensor import Tensor
 from ..core import dtype as dtypes
 
 _lock = threading.Lock()
-_KEY = jax.random.PRNGKey(0)
+_KEY = None   # lazy: creating a key initializes the JAX backend; defer until
+              # first use so `import paddle_tpu` never touches the device.
 
 
 def seed(s):
@@ -56,12 +57,22 @@ def next_key():
         return jax.random.fold_in(entry[0], entry[1])
     global _KEY
     with _lock:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(0)
         _KEY, sub = jax.random.split(_KEY)
     return sub
 
 
+def _ensure_key():
+    global _KEY
+    with _lock:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(0)
+        return _KEY
+
+
 def get_rng_state():
-    return _KEY
+    return _ensure_key()
 
 
 def set_rng_state(state):

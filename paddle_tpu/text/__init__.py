@@ -1,0 +1,4 @@
+"""paddle.text parity: NLP datasets."""
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+    ViterbiDecoder, viterbi_decode)

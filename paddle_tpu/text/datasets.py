@@ -1,0 +1,234 @@
+"""Text datasets. Reference: python/paddle/text/datasets/*.
+
+Offline: each dataset reads the reference's file formats from
+$PADDLE_TPU_DATA_HOME when present, else generates deterministic synthetic
+corpora with the same item structure, so pipelines run without egress.
+"""
+import os
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+DATA_HOME = os.path.expanduser(os.environ.get('PADDLE_TPU_DATA_HOME',
+                                              '~/.cache/paddle_tpu/datasets'))
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (word-id sequence, 0/1 label)."""
+
+    def __init__(self, data_file=None, mode='train', cutoff=150, download=True):
+        data_file = data_file or os.path.join(DATA_HOME, 'imdb', 'aclImdb_v1.tar.gz')
+        self.word_idx = {}
+        self.docs, self.labels = [], []
+        if os.path.exists(data_file):
+            self._load_tar(data_file, mode, cutoff)
+        else:
+            rng = np.random.RandomState(0 if mode == 'train' else 1)
+            vocab = 500
+            self.word_idx = {f'w{i}': i for i in range(vocab)}
+            n = 512 if mode == 'train' else 128
+            for i in range(n):
+                ln = rng.randint(5, 60)
+                self.docs.append(rng.randint(0, vocab, ln).tolist())
+                self.labels.append(int(rng.rand() > 0.5))
+
+    def _load_tar(self, path, mode, cutoff):
+        import re
+        import collections
+        pos_pat = re.compile(rf'aclImdb/{mode}/pos/.*\.txt$')
+        neg_pat = re.compile(rf'aclImdb/{mode}/neg/.*\.txt$')
+        tokenize = re.compile(r'[a-z]+').findall
+        freq = collections.Counter()
+        texts = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                label = 1 if pos_pat.match(m.name) else \
+                    (0 if neg_pat.match(m.name) else None)
+                if label is None:
+                    continue
+                words = tokenize(tf.extractfile(m).read().decode().lower())
+                freq.update(words)
+                texts.append((words, label))
+        vocab = [w for w, _ in freq.most_common(cutoff)]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        for words, label in texts:
+            self.docs.append([self.word_idx.get(w, unk) for w in words])
+            self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.docs[idx], 'int64'),
+                np.asarray(self.labels[idx], 'int64'))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=5,
+                 mode='train', min_word_freq=50, download=True):
+        self.window_size = window_size
+        rng = np.random.RandomState(2 if mode == 'train' else 3)
+        vocab = 300
+        self.word_idx = {f'w{i}': i for i in range(vocab)}
+        n = 2048 if mode == 'train' else 256
+        stream = rng.randint(0, vocab, n + window_size)
+        self.samples = [stream[i:i + window_size].astype('int64')
+                        for i in range(n)]
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        return tuple(np.asarray(x, 'int64') for x in s)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode='train', test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rng = np.random.RandomState(rand_seed)
+        n = 1024 if mode == 'train' else 128
+        self.rows = [(rng.randint(1, 943), rng.randint(0, 2), rng.randint(1, 50),
+                      rng.randint(1, 1682), rng.randint(0, 19),
+                      float(rng.randint(1, 6))) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        u, g, a, m, c, r = self.rows[idx]
+        return (np.asarray(u, 'int64'), np.asarray(g, 'int64'),
+                np.asarray(a, 'int64'), np.asarray(m, 'int64'),
+                np.asarray(c, 'int64'), np.asarray(r, 'float32'))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode='train', download=True):
+        data_file = data_file or os.path.join(DATA_HOME, 'uci_housing',
+                                              'housing.data')
+        if os.path.exists(data_file):
+            data = np.loadtxt(data_file).astype('float32')
+        else:
+            rng = np.random.RandomState(4)
+            X = rng.rand(506, 13).astype('float32')
+            w = rng.rand(13).astype('float32')
+            y = (X @ w * 10 + rng.randn(506).astype('float32'))[:, None]
+            data = np.concatenate([X, y], axis=1)
+        split = int(len(data) * 0.8)
+        self.data = data[:split] if mode == 'train' else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _SyntheticTranslation(Dataset):
+    SRC_VOCAB = 200
+    TRG_VOCAB = 220
+
+    def __init__(self, mode='train', seed=5):
+        rng = np.random.RandomState(seed if mode == 'train' else seed + 1)
+        n = 512 if mode == 'train' else 64
+        self.pairs = []
+        for _ in range(n):
+            ln = rng.randint(3, 20)
+            src = rng.randint(3, self.SRC_VOCAB, ln)
+            trg = rng.randint(3, self.TRG_VOCAB, ln + rng.randint(-2, 3))
+            self.pairs.append((src, trg))
+        self.src_word_idx = {f's{i}': i for i in range(self.SRC_VOCAB)}
+        self.trg_word_idx = {f't{i}': i for i in range(self.TRG_VOCAB)}
+
+    def __getitem__(self, idx):
+        src, trg = self.pairs[idx]
+        trg_in = np.concatenate([[1], trg]).astype('int64')
+        trg_out = np.concatenate([trg, [2]]).astype('int64')
+        return src.astype('int64'), trg_in, trg_out
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode='train', dict_size=30000,
+                 download=True):
+        super().__init__(mode, seed=6)
+
+
+class WMT16(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode='train', src_dict_size=30000,
+                 trg_dict_size=30000, lang='en', download=True):
+        super().__init__(mode, seed=7)
+
+
+class Conll05st(Dataset):
+    """SRL dataset: (pred, mark, word seq, label seq)."""
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, mode='train',
+                 download=True):
+        rng = np.random.RandomState(8)
+        n = 256
+        self.samples = []
+        for _ in range(n):
+            ln = rng.randint(5, 30)
+            words = rng.randint(0, 300, ln).astype('int64')
+            pred = rng.randint(0, 50, ln).astype('int64')
+            labels = rng.randint(0, 20, ln).astype('int64')
+            self.samples.append((words, pred, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding via lax.scan. Returns (scores, paths)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    pot = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = transition_params._value if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    B, T, N = pot.shape
+
+    def step(carry, emit):
+        score = carry                                   # [B, N]
+        cand = score[:, :, None] + trans[None]          # [B, N, N]
+        best = jnp.max(cand, axis=1) + emit
+        idx = jnp.argmax(cand, axis=1)
+        return best, idx
+
+    score0 = pot[:, 0]
+    scores, idxs = jax.lax.scan(step, score0, jnp.moveaxis(pot[:, 1:], 1, 0))
+    final_best = jnp.argmax(scores, axis=-1)
+
+    def backtrack(carry, idx_t):
+        cur = carry
+        prev = jnp.take_along_axis(idx_t, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    _, path_rev = jax.lax.scan(backtrack, final_best, idxs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             final_best[:, None]], axis=1)
+    return Tensor(jnp.max(scores, -1)), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include)
