@@ -42,6 +42,8 @@ from .hapi import summary, flops, callbacks  # noqa: F401
 from .batch import batch  # noqa: F401
 from .nn.layer_base import ParamAttr  # noqa: F401
 from .utils.misc import disable_static, enable_static, in_dynamic_mode, grad  # noqa: F401
+from .tensor import signal  # noqa: F401
+from . import sysconfig  # noqa: F401
 
 # Subpackages imported lazily to keep import light:
 #   paddle_tpu.distributed, paddle_tpu.vision, paddle_tpu.text,
@@ -51,6 +53,7 @@ from .utils.misc import disable_static, enable_static, in_dynamic_mode, grad  # 
 def __getattr__(name):
     import importlib
     if name in ('distributed', 'vision', 'text', 'distribution', 'inference',
-                'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler'):
+                'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler',
+                'hub', 'onnx', 'parallel'):
         return importlib.import_module(f'.{name}', __name__)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,41 @@
+"""paddle.hub — local-directory model hub (no egress).
+Reference: python/paddle/hub.py (github/gitee/local sources)."""
+import importlib.util
+import os
+
+HUB_DIR = os.path.expanduser(os.environ.get('PADDLE_TPU_HUB_DIR',
+                                            '~/.cache/paddle_tpu/hub'))
+
+
+def _load_entrypoints(repo_dir):
+    path = os.path.join(repo_dir, 'hubconf.py')
+    if not os.path.exists(path):
+        raise RuntimeError(f'no hubconf.py in {repo_dir}')
+    spec = importlib.util.spec_from_file_location('hubconf', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source != 'local':
+        raise RuntimeError(
+            "offline build: only source='local' is supported; clone the hub "
+            'repo into a local directory first')
+    return repo_dir
+
+
+def list(repo_dir, source='local', force_reload=False):
+    mod = _load_entrypoints(_resolve(repo_dir, source))
+    return [n for n in dir(mod) if callable(getattr(mod, n))
+            and not n.startswith('_')]
+
+
+def help(repo_dir, model, source='local', force_reload=False):
+    mod = _load_entrypoints(_resolve(repo_dir, source))
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, *args, source='local', force_reload=False, **kwargs):
+    mod = _load_entrypoints(_resolve(repo_dir, source))
+    return getattr(mod, model)(*args, **kwargs)

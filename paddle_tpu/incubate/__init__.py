@@ -1,0 +1,111 @@
+"""paddle.incubate parity: experimental features.
+Reference: python/paddle/incubate/ (LookAhead/ModelAverage optimizers,
+softmax_mask_fuse, graph ops)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..optimizer.optimizer import Optimizer
+
+
+@op
+def softmax_mask_fuse(x, mask, name=None):
+    import jax
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+@op
+def softmax_mask_fuse_upper_triangle(x):
+    import jax
+    S = x.shape[-1]
+    mask = jnp.triu(jnp.full((S, S), -1e30, x.dtype), k=1)
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+class LookAhead(Optimizer):
+    """Reference: python/paddle/incubate/optimizer/lookahead.py."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(inner_optimizer._lr, inner_optimizer._parameters)
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step_count = 0
+
+    def step(self):
+        self.inner.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner._parameters:
+                sid = id(p)
+                if sid not in self._slow:
+                    self._slow[sid] = p._value
+                slow = self._slow[sid] + self.alpha * (p._value - self._slow[sid])
+                self._slow[sid] = slow
+                p._replace_value(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner.clear_grad(*a, **k)
+
+
+class ModelAverage(Optimizer):
+    """Reference: python/paddle/incubate/optimizer/modelaverage.py."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        super().__init__(0.0, parameters)
+        self._sums = {id(p): jnp.zeros_like(p._value) for p in self._parameters}
+        self._counts = {id(p): 0 for p in self._parameters}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameters:
+            self._sums[id(p)] = self._sums[id(p)] + p._value
+            self._counts[id(p)] += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            for p in self._parameters:
+                self._backup[id(p)] = p._value
+                if self._counts[id(p)]:
+                    p._replace_value(self._sums[id(p)] / self._counts[id(p)])
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return _ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameters:
+            if id(p) in self._backup:
+                p._replace_value(self._backup[id(p)])
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type='sum', out_size=None):
+    from ..core.dispatch import apply_op
+    import jax
+
+    def pure(v, si, di):
+        n = out_size or v.shape[0]
+        gathered = jnp.take(v, jnp.asarray(si).astype(jnp.int32), axis=0)
+        seg = jnp.asarray(di).astype(jnp.int32)
+        if pool_type == 'sum':
+            return jax.ops.segment_sum(gathered, seg, num_segments=n) \
+                if hasattr(jax.ops, 'segment_sum') else \
+                jnp.zeros((n,) + v.shape[1:], v.dtype).at[seg].add(gathered)
+        if pool_type == 'mean':
+            s = jnp.zeros((n,) + v.shape[1:], v.dtype).at[seg].add(gathered)
+            c = jnp.zeros((n,), v.dtype).at[seg].add(1.0)
+            return s / jnp.maximum(c, 1.0)[:, None]
+        if pool_type == 'max':
+            base = jnp.full((n,) + v.shape[1:], -jnp.inf, v.dtype)
+            return base.at[seg].max(gathered)
+        if pool_type == 'min':
+            base = jnp.full((n,) + v.shape[1:], jnp.inf, v.dtype)
+            return base.at[seg].min(gathered)
+        raise ValueError(pool_type)
+    return apply_op(pure, x, src_index, dst_index)

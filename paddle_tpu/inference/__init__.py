@@ -95,9 +95,12 @@ class Predictor:
         if path.endswith('.pdmodel'):
             path = path[:-len('.pdmodel')]
         from ..framework_io import load as fload
+
+        def _arr(v):
+            return jnp.asarray(getattr(v, '_value', v))
         state = fload(path + '.pdparams')
-        self._params = {k: jnp.asarray(v._value) for k, v in state['params'].items()}
-        self._buffers = {k: jnp.asarray(v._value) for k, v in state['buffers'].items()}
+        self._params = {k: _arr(v) for k, v in state['params'].items()}
+        self._buffers = {k: _arr(v) for k, v in state['buffers'].items()}
         with open(path + '.pdmodel') as f:
             self._meta = json.load(f)
         self._input_names = [f'x{i}' for i in range(

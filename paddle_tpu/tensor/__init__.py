@@ -12,6 +12,7 @@ from .random import (  # noqa: F401
     bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
     randn, randperm, seed, standard_normal, uniform)
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from .register import install as _install
 
 _install()

@@ -1,0 +1,109 @@
+"""Signal ops: frame, overlap_add, stft, istft.
+Reference: python/paddle/tensor/signal.py."""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+
+@op
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    n = x.shape[axis]
+    n_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    moved = jnp.moveaxis(x, axis, -1)
+    frames = moved[..., idx]                      # [..., n_frames, frame_length]
+    if axis in (-1, x.ndim - 1):
+        return jnp.moveaxis(frames, (-2, -1), (-1, -2))
+    return frames
+
+
+@op
+def overlap_add(x, hop_length, axis=-1, name=None):
+    # x: [..., frame_length, n_frames] (axis=-1 layout)
+    moved = jnp.moveaxis(x, axis, -1) if axis not in (-1, x.ndim - 1) else x
+    frame_length, n_frames = moved.shape[-2], moved.shape[-1]
+    out_len = frame_length + hop_length * (n_frames - 1)
+    base = jnp.zeros(moved.shape[:-2] + (out_len,), moved.dtype)
+
+    def body(i, acc):
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc, jax.lax.dynamic_slice_in_dim(acc, i * hop_length, frame_length,
+                                              axis=-1) + moved[..., i],
+            i * hop_length, axis=-1)
+    return jax.lax.fori_loop(0, n_frames, body, base)
+
+
+def _window_arr(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    if isinstance(window, Tensor):
+        return window._value.astype(dtype)
+    return jnp.asarray(window).astype(dtype)
+
+
+@op
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode='reflect', normalized=False, onesided=True, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length, jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    sig = x
+    if center:
+        pads = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        sig = jnp.pad(sig, pads, mode=pad_mode)
+    n = sig.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = sig[..., idx] * w                      # [..., n_frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+        jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    return jnp.swapaxes(spec, -1, -2)               # [..., freq, n_frames]
+
+
+@op
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length, jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    spec = jnp.swapaxes(x, -1, -2)                  # [..., n_frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+        jnp.real(jnp.fft.ifft(spec, axis=-1))
+    frames = frames * w
+    n_frames = frames.shape[-2]
+    out_len = n_fft + hop_length * (n_frames - 1)
+    sig = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    win_sq = jnp.zeros((out_len,), frames.dtype)
+
+    def body(i, carry):
+        s, ws = carry
+        seg = jax.lax.dynamic_slice_in_dim(s, i * hop_length, n_fft, axis=-1)
+        s = jax.lax.dynamic_update_slice_in_dim(s, seg + frames[..., i, :],
+                                                i * hop_length, axis=-1)
+        wseg = jax.lax.dynamic_slice_in_dim(ws, i * hop_length, n_fft, axis=-1)
+        ws = jax.lax.dynamic_update_slice_in_dim(ws, wseg + jnp.square(w),
+                                                 i * hop_length, axis=-1)
+        return s, ws
+
+    sig, win_sq = jax.lax.fori_loop(0, n_frames, body, (sig, win_sq))
+    sig = sig / jnp.maximum(win_sq, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2:-(n_fft // 2)]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

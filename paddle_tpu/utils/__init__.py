@@ -9,3 +9,5 @@ def try_import(name):
     except ImportError as e:
         raise ImportError(f'{name} is required but not installed '
                           '(no-egress environment: gate this feature)') from e
+
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,82 @@
+"""Distributed checkpoint/resume (orbax-backed).
+
+Reference capability: fleet checkpoint utilities + fluid io.save/load_persistables
+for sharded training state. TPU-native: orbax async checkpointing is
+sharding-aware — each host writes its own shards, restore re-places arrays on
+the mesh. ``CheckpointManager`` adds keep-policies and auto-resume (the
+elastic-recovery story together with distributed/launch.py's restart loop).
+"""
+import os
+
+import jax
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory, max_to_keep=3):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                            create=True)
+        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def save(self, step, state, wait=False):
+        """state: pytree of jax arrays (params/opt_state/buffers/meta)."""
+        ocp = _ocp()
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore(self, step=None, template=None):
+        ocp = _ocp()
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        if template is not None:
+            return self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_checkpoint(path, state, step=0):
+    mgr = CheckpointManager(path)
+    mgr.save(step, state, wait=True)
+    mgr.close()
+
+
+def load_checkpoint(path, template=None):
+    mgr = CheckpointManager(path)
+    out = mgr.restore(template=template)
+    mgr.close()
+    return out
+
+
+def auto_resume(path, init_fn, template=None):
+    """Elastic-recovery entry: restore the newest checkpoint if one exists,
+    else build fresh state with init_fn(). Returns (state, start_step)."""
+    try:
+        mgr = CheckpointManager(path)
+        step = mgr.latest_step()
+        if step is not None:
+            state = mgr.restore(step, template=template)
+            mgr.close()
+            return state, step + 1
+        mgr.close()
+    except Exception:
+        pass
+    return init_fn(), 0

@@ -1,0 +1,139 @@
+"""io: datasets, samplers, DataLoader (sync + native workers), save/load."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ChainDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler, random_split)
+
+
+class _Square(Dataset):
+    def __getitem__(self, i):
+        return np.asarray([i * i], 'float32'), np.asarray([i], 'int64')
+
+    def __len__(self):
+        return 10
+
+
+class _Stream(IterableDataset):
+    def __iter__(self):
+        for i in range(7):
+            yield np.asarray([i], 'float32')
+
+
+def test_tensor_dataset_and_loader():
+    X = paddle.to_tensor(np.arange(12).reshape(6, 2).astype('float32'))
+    Y = paddle.to_tensor(np.arange(6).astype('int64'))
+    ds = TensorDataset([X, Y])
+    assert len(ds) == 6
+    dl = DataLoader(ds, batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0][0].shape == [4, 2]
+    assert batches[1][0].shape == [2, 2]
+    dl2 = DataLoader(ds, batch_size=4, drop_last=True)
+    assert len(list(dl2)) == 1
+
+
+def test_map_dataset_order_and_shuffle():
+    dl = DataLoader(_Square(), batch_size=5, shuffle=False)
+    b = list(dl)
+    assert b[0][1].numpy().reshape(-1).tolist() == [0, 1, 2, 3, 4]
+    paddle.seed(0)
+    np.random.seed(0)
+    dl = DataLoader(_Square(), batch_size=10, shuffle=True)
+    vals = list(dl)[0][1].numpy().reshape(-1).tolist()
+    assert sorted(vals) == list(range(10))
+
+
+def test_iterable_dataset():
+    dl = DataLoader(_Stream(), batch_size=3)
+    shapes = [b.shape[0] for b in dl]
+    assert shapes == [3, 3, 1]
+
+
+def test_samplers():
+    ds = _Square()
+    assert list(SequenceSampler(ds)) == list(range(10))
+    assert sorted(RandomSampler(ds)) == list(range(10))
+    w = WeightedRandomSampler([0.0, 1.0, 0.0], 20)
+    assert set(w) == {1}
+    bs = BatchSampler(ds, batch_size=3, drop_last=True)
+    assert len(bs) == 3
+    assert all(len(b) == 3 for b in bs)
+
+
+def test_distributed_batch_sampler():
+    ds = _Square()
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert not set(i0) & set(i1)
+
+
+def test_subset_split_chain():
+    ds = _Square()
+    sub = Subset(ds, [1, 3])
+    assert len(sub) == 2 and float(sub[1][0]) == 9.0
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+    ch = ChainDataset([_Stream(), _Stream()])
+    assert sum(1 for _ in ch) == 14
+
+
+def test_native_worker_loader():
+    ds = _Square()
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 3
+    got = sorted(i for b in batches for i in b[1].numpy().reshape(-1).tolist())
+    assert got == list(range(10))
+
+
+def test_lm_token_loader():
+    from paddle_tpu.io.native_loader import LMTokenLoader
+    toks = np.arange(5000, dtype=np.int32)
+    l = LMTokenLoader(toks, batch_size=2, seq_len=8, n_workers=2, ring_cap=2)
+    b = l.next_batch()
+    assert b.shape == (2, 8)
+    assert (b[0] == np.arange(8)).all()
+    l.close()
+
+
+def test_save_load_roundtrip():
+    import paddle_tpu.nn as nn
+    with tempfile.TemporaryDirectory() as d:
+        lin = nn.Linear(3, 2)
+        path = os.path.join(d, 'model.pdparams')
+        paddle.save(lin.state_dict(), path)
+        loaded = paddle.load(path)
+        lin2 = nn.Linear(3, 2)
+        lin2.set_state_dict(loaded)
+        assert np.allclose(lin.weight.numpy(), lin2.weight.numpy())
+
+
+def test_hapi_save_load():
+    import paddle_tpu.nn as nn
+    with tempfile.TemporaryDirectory() as d:
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        X = np.random.rand(8, 4).astype('float32')
+        Y = np.random.randint(0, 2, (8, 1)).astype('int64')
+        ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+        model.fit(ds, epochs=1, batch_size=4, verbose=0)
+        model.save(os.path.join(d, 'ckpt'))
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2 = paddle.Model(net2)
+        m2.prepare(paddle.optimizer.Adam(0.01, parameters=m2.parameters()),
+                   nn.CrossEntropyLoss())
+        m2.load(os.path.join(d, 'ckpt'))
+        x = paddle.to_tensor(X[:2])
+        assert np.allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)

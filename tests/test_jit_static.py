@@ -1,0 +1,130 @@
+"""jit.to_static parity, save/load, inference Predictor, static Executor."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_to_static_function_parity():
+    def f(a, b):
+        return paddle.tanh(paddle.matmul(a, b)) + 1
+
+    sf = paddle.jit.to_static(f)
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    assert np.allclose(sf(a, b).numpy(), f(a, b).numpy(), rtol=1e-5)
+
+
+def test_to_static_layer_parity_and_grad():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return nn.functional.relu(self.fc(x))
+
+    net = Net()
+    x = paddle.randn([2, 4])
+    eager = net(x).numpy()
+    net.forward = paddle.jit.to_static(net.forward)
+    static = net(x)
+    assert np.allclose(static.numpy(), eager, rtol=1e-5)
+    loss = static.sum()
+    loss.backward()
+    assert net.fc.weight.grad is not None
+
+
+def test_to_static_batchnorm_buffers_update():
+    bn = nn.BatchNorm1D(4)
+    bn.forward = paddle.jit.to_static(bn.forward)
+    bn.train()
+    x = paddle.randn([8, 4]) * 2 + 5
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+
+
+def test_jit_save_load_predict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    with tempfile.TemporaryDirectory() as d:
+        net = Net()
+        net.eval()
+        path = os.path.join(d, 'inf')
+        spec = [paddle.static.InputSpec([2, 4], 'float32')]
+        paddle.jit.save(net, path, input_spec=spec)
+        assert os.path.exists(path + '.pdparams')
+        assert os.path.exists(path + '.pdmodel')
+        assert os.path.exists(path + '.stablehlo')
+        hlo = open(path + '.stablehlo').read()
+        assert 'stablehlo' in hlo or 'module' in hlo
+
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config(path + '.pdmodel')
+        pred = create_predictor(cfg)
+        pred.attach_layer(Net())
+        x = np.random.rand(2, 4).astype('float32')
+        (out,) = pred.run([x])
+        ref = x @ np.asarray(net.fc.weight.numpy()) + net.fc.bias.numpy()
+        assert np.allclose(out, ref, rtol=1e-4)
+
+        # named-handle API
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out2 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        assert np.allclose(out2, ref, rtol=1e-4)
+
+
+def test_static_program_executor():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data('x', [None, 3], 'float32')
+            y = paddle.static.data('y', [None, 3], 'float32')
+            z = paddle.tanh(x + y * 2)
+        exe = paddle.static.Executor()
+        a = np.random.rand(2, 3).astype('float32')
+        b = np.random.rand(2, 3).astype('float32')
+        (out,) = exe.run(main, feed={'x': a, 'y': b}, fetch_list=[z])
+        assert np.allclose(out, np.tanh(a + b * 2), rtol=1e-5)
+        # run again with new feeds (compiled program reused)
+        (out2,) = exe.run(main, feed={'x': b, 'y': a}, fetch_list=[z])
+        assert np.allclose(out2, np.tanh(b + a * 2), rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_amp_autocast():
+    import jax.numpy as jnp
+    with paddle.amp.auto_cast(True, level='O1'):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+        s = paddle.add(a, b)          # not in white list
+        assert s.dtype == jnp.float32
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == jnp.float32
+
+
+def test_amp_grad_flows_to_fp32_master():
+    with paddle.amp.auto_cast(True):
+        lin = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+        loss = lin(x).astype('float32').mean()
+    loss.backward()
+    assert lin.weight.grad is not None
+    import jax.numpy as jnp
+    assert lin.weight.grad.dtype == jnp.float32

@@ -1,0 +1,174 @@
+"""Coverage for aux components: metrics, distributions, vision, text, signal,
+amp scaler, profiler, checkpoint manager, clip, incubate."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_metrics():
+    from paddle_tpu.metric import Accuracy, Precision, Recall, Auc, accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], 'float32'))
+    label = paddle.to_tensor(np.array([[1], [1]], 'int64'))
+    c = m.compute(pred, label)
+    m.update(c)
+    assert abs(m.accumulate() - 0.5) < 1e-6
+    p = Precision()
+    p.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+    r = Recall()
+    r.update(np.array([0.9, 0.1]), np.array([1, 1]))
+    assert abs(r.accumulate() - 0.5) < 1e-6
+    a = Auc()
+    a.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+    assert a.accumulate() > 0.9
+    acc = accuracy(pred, label)
+    assert abs(float(acc) - 0.5) < 1e-6
+
+
+def test_distributions():
+    from paddle_tpu.distribution import Categorical, Normal, Uniform
+    paddle.seed(0)
+    n = Normal(0.0, 1.0)
+    s = n.sample([2000])
+    assert abs(float(s.mean())) < 0.1
+    lp = n.log_prob(paddle.to_tensor(np.array([0.0], 'float32')))
+    assert abs(float(lp) - (-0.9189385)) < 1e-4
+    u = Uniform(0.0, 2.0)
+    su = u.sample([1000])
+    assert 0 <= float(su.min()) and float(su.max()) <= 2
+    assert abs(float(u.entropy()) - np.log(2)) < 1e-5
+    c = Categorical(paddle.to_tensor(np.array([0.0, 0.0], 'float32')))
+    e = c.entropy()
+    assert abs(float(e) - np.log(2)) < 1e-5
+    kl = Normal(0.0, 1.0).kl_divergence(Normal(0.0, 1.0))
+    assert abs(float(kl)) < 1e-6
+
+
+def test_vision_transforms():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(32, 48, 3) * 255).astype('uint8')
+    t = T.Compose([T.Resize(16), T.CenterCrop(16), T.ToTensor()])
+    out = t(img)
+    assert out.shape == [3, 16, 16]
+    assert float(out.numpy().max()) <= 1.0
+    flipped = T.RandomHorizontalFlip(1.0)(img)
+    assert np.allclose(flipped, img[:, ::-1])
+    norm = T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5], data_format='HWC')
+    nn_ = norm(img.astype('float32') / 255)
+    assert nn_.min() >= -1.01 and nn_.max() <= 1.01
+
+
+def test_vision_datasets_synthetic():
+    from paddle_tpu.vision.datasets import MNIST, Cifar10
+    ds = MNIST(mode='test')
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    c = Cifar10(mode='test')
+    img, label = c[0]
+    assert img.shape == (32, 32, 3)
+
+
+def test_text_datasets_and_viterbi():
+    from paddle_tpu.text import Imikolov, UCIHousing, WMT14, viterbi_decode
+    ds = Imikolov(window_size=5)
+    assert len(ds[0]) == 5
+    h = UCIHousing(mode='test')
+    x, y = h[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    w = WMT14(mode='test')
+    src, tin, tout = w[0]
+    assert len(tin) == len(tout)
+    pot = paddle.to_tensor(np.random.rand(2, 5, 3).astype('float32'))
+    trans = paddle.to_tensor(np.random.rand(3, 3).astype('float32'))
+    scores, paths = viterbi_decode(pot, trans)
+    assert paths.shape == [2, 5]
+
+
+def test_vision_ops_nms_roi():
+    from paddle_tpu.vision.ops import nms, roi_align
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], 'float32'))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], 'float32'))
+    keep = nms(boxes, 0.5, scores)
+    assert keep.numpy().tolist() == [0, 2]
+    x = paddle.randn([1, 4, 16, 16])
+    rois = paddle.to_tensor(np.array([[0, 0, 8, 8]], 'float32'))
+    out = roi_align(x, rois, paddle.to_tensor(np.array([1], 'int32')), 4)
+    assert out.shape == [1, 4, 4, 4]
+
+
+def test_signal_stft_istft():
+    x = paddle.randn([512])
+    S = paddle.signal.stft(x, n_fft=128, hop_length=32)
+    y = paddle.signal.istft(S, n_fft=128, hop_length=32, length=512)
+    assert float((y - x).abs().max()) < 1e-4
+
+
+def test_checkpoint_manager():
+    import jax.numpy as jnp
+    from paddle_tpu.utils.checkpoint import CheckpointManager, auto_resume
+    with tempfile.TemporaryDirectory() as d:
+        state = {'w': jnp.arange(6.0).reshape(2, 3), 'step': jnp.asarray(3)}
+        mgr = CheckpointManager(d)
+        mgr.save(0, state, wait=True)
+        mgr.save(1, {'w': state['w'] * 2, 'step': jnp.asarray(4)}, wait=True)
+        assert mgr.latest_step() == 1
+        restored = mgr.restore(template=state)
+        assert np.allclose(np.asarray(restored['w']), np.arange(6.0).reshape(2, 3) * 2)
+        mgr.close()
+        st, start = auto_resume(d, lambda: state, template=state)
+        assert start == 2
+
+
+def test_incubate():
+    from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle, LookAhead
+    import paddle_tpu.nn as nn
+    x = paddle.randn([1, 2, 4, 4])
+    p = softmax_mask_fuse_upper_triangle(x)
+    pn = p.numpy()
+    assert np.allclose(np.triu(pn[0, 0], 1), 0, atol=1e-6)
+    lin = nn.Linear(2, 2)
+    base = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    la = LookAhead(base, alpha=0.5, k=2)
+    for _ in range(4):
+        loss = lin(paddle.ones([1, 2])).sum()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+
+
+def test_spectral_and_weightnorm_integration():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(4, 4)
+    spectral_norm(lin)
+    out = lin(paddle.ones([1, 4]))
+    w = lin.weight
+    sv = np.linalg.svd(np.asarray(w.numpy()), compute_uv=False)[0]
+    assert sv < 3.0
+
+
+def test_device_api():
+    assert paddle.device.device_count() >= 1
+    d = paddle.get_device()
+    assert ':' in d
+    p = paddle.CPUPlace()
+    assert p.jax_device() is not None
+
+
+def test_beam_decode():
+    import paddle_tpu.nn as nn
+    cell = nn.GRUCell(8, 8)
+    emb = nn.Embedding(12, 8)
+    head = nn.Linear(8, 12)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=1,
+                               embedding_fn=emb, output_fn=head)
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    ids, scores = nn.dynamic_decode(dec, inits=jnp.zeros((3, 8)),
+                                    max_step_num=5)
+    assert ids.shape[0] == 3 and ids.shape[1] <= 5
