@@ -143,7 +143,7 @@ class Model:
         if update:
             self._write_back(new_p, new_b)
             self._opt_state = new_s
-            from ..optimizer.lr import LRScheduler
+        self._last_outputs = out
         return [np.asarray(loss)]
 
     def eval_batch(self, inputs, labels=None):
@@ -218,10 +218,15 @@ class Model:
     def _update_metrics(self, logs, inputs, labels):
         if not self._metrics or not labels:
             return
-        with no_grad_ctx():
+        # reuse the forward outputs already computed inside the train step
+        out = getattr(self, '_last_outputs', None)
+        if out is None:
             preds = self.predict_batch([Tensor(i) for i in inputs])
+            first = jnp.asarray(preds[0])
+        else:
+            first = out[0] if isinstance(out, (list, tuple)) else out
         for m in self._metrics:
-            res = m.compute(Tensor(jnp.asarray(preds[0])), Tensor(labels[0]))
+            res = m.compute(Tensor(first), Tensor(labels[0]))
             acc = m.update(res)
             names = m.name() if isinstance(m.name(), list) else [m.name()]
             vals = acc if isinstance(acc, list) else [acc]

@@ -14,6 +14,7 @@ from ..core.tensor import Tensor
 from ..core import dtype as dtypes
 from .input_spec import InputSpec  # noqa: F401
 from . import amp  # noqa: F401
+from . import nn  # noqa: F401
 
 
 class Variable(Tensor):

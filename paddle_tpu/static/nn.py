@@ -1,0 +1,74 @@
+"""static.nn functional helpers (declarative API surface).
+Reference: python/paddle/static/nn/__init__.py (fc, conv2d, batch_norm, ...).
+Each creates parameters in the default static Program scope and applies the
+corresponding functional op — our static mode shares the eager op library.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Parameter, ParamAttr
+from ..nn import functional as F
+from ..nn import initializer as I
+
+_param_registry = []
+
+
+def _make_param(shape, attr, default_init, dtype='float32'):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_init
+    p = Parameter(init(tuple(shape), jnp.dtype(dtype)), name=attr.name)
+    _param_registry.append(p)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_dim *= s
+    from ..tensor.manipulation import reshape
+    flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    w = _make_param((in_dim, size), weight_attr, I.XavierNormal())
+    b = _make_param((size,), bias_attr, I.Constant(0.0))
+    out = F.linear(flat, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format='NCHW', name=None):
+    cin = input.shape[1] if data_format == 'NCHW' else input.shape[-1]
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr,
+                    I.KaimingUniform(fan_in=cin * ks[0] * ks[1] // groups))
+    b = _make_param((num_filters,), bias_attr, I.Constant(0.0))
+    out = F.conv2d(input, w, b, stride, padding, dilation, groups, data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout='NCHW', is_test=False, name=None):
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    w = _make_param((c,), param_attr, I.Constant(1.0))
+    b = _make_param((c,), bias_attr, I.Constant(0.0))
+    rm = Tensor(jnp.zeros((c,), jnp.float32))
+    rv = Tensor(jnp.ones((c,), jnp.float32))
+    out = F.batch_norm(input, rm, rv, w, b, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype='float32'):
+    w = _make_param(tuple(size), param_attr, I.Normal(0.0, 0.02), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
