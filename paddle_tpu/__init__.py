@@ -44,6 +44,20 @@ from .nn.layer_base import ParamAttr  # noqa: F401
 from .utils.misc import disable_static, enable_static, in_dynamic_mode, grad  # noqa: F401
 from .tensor import signal  # noqa: F401
 from . import sysconfig  # noqa: F401
+from .compat_api import *  # noqa: F401,F403
+from .compat_api import dtype, VarBase, t  # noqa: F401
+from .version import full_version, commit  # noqa: F401
+from . import version  # noqa: F401
+from . import callbacks as callbacks_mod  # noqa: F401
+from .device import (  # noqa: F401
+    CUDAPinnedPlace, NPUPlace, XPUPlace, is_compiled_with_cuda,
+    is_compiled_with_npu, is_compiled_with_xpu, is_compiled_with_tpu)
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+def is_compiled_with_rocm():
+    return False
+
 
 # Subpackages imported lazily to keep import light:
 #   paddle_tpu.distributed, paddle_tpu.vision, paddle_tpu.text,

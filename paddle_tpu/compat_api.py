@@ -1,0 +1,176 @@
+"""Remaining top-level API surface (parity audit closers).
+Reference: python/paddle/__init__.py exports not covered elsewhere.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import op, apply_op
+from .core.tensor import Tensor
+from .core import dtype as _dtype_mod
+
+# type aliases
+dtype = np.dtype
+VarBase = Tensor
+
+_default_dtype = 'float32'
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = str(np.dtype(_dtype_mod.convert_dtype(d)))
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op(lambda xs: sum(jnp.asarray(x) for x in xs), list(inputs))
+
+
+@op
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def t(input, name=None):
+    return input.T if input.ndim <= 2 else input
+
+
+def unstack(x, axis=0, num=None):
+    from .tensor.manipulation import unbind
+    return unbind(x, axis)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype='int64', name=None):
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) \
+        if arr.ndim > 1 else arr[1:] != arr[:-1]
+    vals = arr[keep]
+    out = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv.astype('int64'))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        out.append(Tensor(jnp.asarray(counts.astype('int64'))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    from .tensor.manipulation import crop
+    return crop(x, shape, offsets)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .tensor.manipulation import scatter
+    out = scatter(x, index, updates, overwrite)
+    x._replace_value(out._value)
+    return x
+
+
+def tanh_(x, name=None):
+    from .tensor.math import tanh
+    out = tanh(x)
+    x._replace_value(out._value)
+    return x
+
+
+def create_parameter(shape, dtype='float32', name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .nn.layer_base import Parameter
+    from .nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    return Parameter(init(tuple(shape), _dtype_mod.convert_dtype(dtype)),
+                     name=name)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw['precision'] = precision
+    if threshold is not None:
+        kw['threshold'] = threshold
+    if edgeitems is not None:
+        kw['edgeitems'] = edgeitems
+    if linewidth is not None:
+        kw['linewidth'] = linewidth
+    if sci_mode is not None:
+        kw['suppress'] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def set_grad_enabled(mode):
+    from .autograd import set_grad_enabled as _s
+    return _s(mode)
+
+
+# dygraph-mode toggles (paddle 2.x dygraph == our eager mode)
+def enable_dygraph(place=None):
+    from .utils.misc import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from .utils.misc import enable_static
+    enable_static()
+
+
+def in_dygraph_mode():
+    from .utils.misc import in_dynamic_mode
+    return in_dynamic_mode()
+
+
+def disable_signal_handler():
+    pass
+
+
+# flags / platform probes
+_flags = {}
+
+
+def set_flags(flags):
+    _flags.update(flags)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _flags.get(f) for f in flags}
+
+
+def get_cudnn_version():
+    return None
+
+
+def get_cuda_rng_state():
+    from .tensor.random import get_rng_state
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    from .tensor.random import set_rng_state
+    if isinstance(state, (list, tuple)) and state:
+        set_rng_state(state[0])
+
+
+def monkey_patch_variable():
+    pass
+
+
+def monkey_patch_math_varbase():
+    pass
+
+
+def check_shape(shape):
+    return True

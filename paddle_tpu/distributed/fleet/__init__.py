@@ -23,6 +23,8 @@ import jax
 from .strategy import DistributedStrategy  # noqa: F401
 from ..topology import HybridTopology, set_topology, get_topology, get_mesh
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     PipelineLayer, LayerDesc, get_rng_state_tracker)
@@ -133,6 +135,36 @@ def shard_opt_state(state, params):
                     return x
         return x
     return jax.tree_util.tree_map(place, state)
+
+
+class RoleMakerBase:
+    """Reference: fleet/base/role_maker.py. Single-controller JAX: every
+    process is a collective worker."""
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=None, worker_num=1, server_endpoints=None,
+                 **kwargs):
+        self.current_id = current_id
 
 
 # ---- UtilBase parity stubs ----

@@ -1,0 +1,80 @@
+"""fleet.utils: recompute (activation checkpointing) + helpers.
+
+Reference: python/paddle/distributed/fleet/utils/recompute.py (re-runs the
+forward in backward, dropping activations). TPU-native: jax.checkpoint wraps
+the pure computation; works in the eager tape (vjp of a checkpointed fn
+stores only inputs) and inside jitted train steps.
+"""
+import jax
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor, no_grad_ctx
+from ...nn.layer_base import Layer
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` so its activations are rematerialized during
+    backward instead of stored. ``function`` may be a Layer or any callable
+    over Tensors."""
+    if isinstance(function, Layer):
+        layer = function
+        pnames = [n for n, _ in layer.named_parameters()]
+        params = [p for _, p in layer.named_parameters()]
+
+        def pure(*vals):
+            from ...nn.layer_base import functional_call
+            p_vals = vals[:len(pnames)]
+            x_vals = vals[len(pnames):]
+            out, _ = functional_call(layer, dict(zip(pnames, p_vals)), None,
+                                     *x_vals, **kwargs)
+            return out
+        return apply_op(jax.checkpoint(pure), *params, *args)
+
+    def pure(*vals):
+        targs = [Tensor(v) for v in vals]
+        with no_grad_ctx():
+            out = function(*targs, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return apply_op(jax.checkpoint(pure), *args)
+
+
+class LocalFS:
+    """Local filesystem helper (reference: fleet/utils/fs.py:LocalFS)."""
+
+    def ls_dir(self, path):
+        import os
+        dirs, files = [], []
+        for e in os.listdir(path):
+            full = os.path.join(path, e)
+            (dirs if os.path.isdir(full) else files).append(e)
+        return dirs, files
+
+    def is_exist(self, path):
+        import os
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        import shutil
+        import os
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def touch(self, path, exist_ok=True):
+        open(path, 'a').close()
+
+    def mv(self, src, dst, overwrite=False):
+        import shutil
+        shutil.move(src, dst)
+
+
+class HDFSClient(LocalFS):
+    def __init__(self, hadoop_home=None, configs=None):
+        raise RuntimeError('HDFS unavailable offline; use LocalFS')

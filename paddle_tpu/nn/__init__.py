@@ -36,3 +36,36 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .layer_rnn import _CellBase as RNNCellBase  # noqa: F401
+from . import layer_loss as loss  # noqa: F401
+from .utils import spectral_norm, weight_norm, remove_weight_norm  # noqa: F401
+from .layer_base import Layer as _LayerForExtras
+from . import quant  # noqa: F401
+
+
+class HSigmoidLoss(_LayerForExtras):
+    """Reference: python/paddle/nn/layer/loss.py:HSigmoidLoss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter((num_classes - 1, feature_size),
+                                            weight_attr)
+        self.bias = self.create_parameter((num_classes - 1, 1), bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return functional.hsigmoid_loss(input, label, self.num_classes,
+                                        self.weight, self.bias)
+
+
+class MaxUnPool2D(_LayerForExtras):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format='NCHW',
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._args
+        return functional.max_unpool2d(x, indices, k, s, p, df, osz)

@@ -9,3 +9,6 @@ from .norm import (  # noqa: F401
     group_norm_fn, instance_norm_fn)
 from .loss import *  # noqa: F401,F403
 from .sparse_attention import scaled_dot_product_attention  # noqa: F401
+from .extension import (  # noqa: F401
+    class_center_sample, diag_embed, elu_, gather_tree, hsigmoid_loss,
+    margin_cross_entropy, max_unpool2d, sequence_mask, tanh_)

@@ -1,0 +1,154 @@
+"""Extension functionals: sequence_mask, diag_embed, gather_tree,
+max_unpool2d, hsigmoid, margin_cross_entropy, class_center_sample.
+Reference: python/paddle/nn/functional/extension.py + loss.py.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op, apply_op
+from ...core.tensor import Tensor
+
+
+@op
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    from ...core.dtype import convert_dtype
+    lengths = jnp.asarray(x)
+    m = maxlen if maxlen is not None else int(jnp.max(lengths))
+    mask = jnp.arange(m)[None, :] < lengths[..., None]
+    return mask.astype(convert_dtype(dtype))
+
+
+@op
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+    out = base.at[..., r, c].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@op
+def gather_tree(ids, parents):
+    """Beam-search backtrace. ids/parents: [T, B, beam]."""
+    T = ids.shape[0]
+
+    def body(carry, t):
+        beams, cur_parents = carry
+        idx = T - 1 - t
+        tok = jnp.take_along_axis(ids[idx], cur_parents, axis=-1)
+        par = jnp.take_along_axis(parents[idx], cur_parents, axis=-1)
+        return (tok, par), tok
+
+    B, W = ids.shape[1], ids.shape[2]
+    init = (ids[-1], parents[-1])
+    (_, _), toks = jax.lax.scan(body, (ids[-1], jnp.tile(jnp.arange(W), (B, 1))),
+                                jnp.arange(T))
+    return jnp.flip(toks, axis=0)
+
+
+@op
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format='NCHW', output_size=None, name=None):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    n, c, h, w = x.shape
+    oh = (h - 1) * stride[0] + kernel_size[0] - 2 * padding
+    ow = (w - 1) * stride[1] + kernel_size[1] - 2 * padding
+    if output_size is not None:
+        oh, ow = output_size[-2:]
+    flat = jnp.reshape(x, (n, c, -1))
+    idx = jnp.reshape(jnp.asarray(indices).astype(jnp.int32), (n, c, -1))
+    base = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(lambda b, i, v: b.at[i].set(v)))(base, idx, flat)
+    return jnp.reshape(out, (n, c, oh, ow))
+
+
+@op
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid with a default complete binary tree."""
+    # default tree: num_classes-1 internal nodes; code of class c = binary path
+    depth = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+    lbl = jnp.asarray(label).astype(jnp.int32).reshape(-1)
+    B = input.shape[0]
+    # node index path: root=0; child = 2i+1 / 2i+2
+    codes = []
+    nodes = []
+    cur = lbl + num_classes - 1          # leaf position in a heap layout
+    for _ in range(depth):
+        parent = (cur - 1) // 2
+        is_right = (cur % 2 == 0)
+        codes.append(is_right)
+        nodes.append(parent)
+        cur = parent
+    nodes = jnp.stack(nodes, axis=1)     # [B, depth]
+    codes = jnp.stack(codes, axis=1).astype(input.dtype)
+    valid = nodes < (num_classes - 1)
+    nodes_c = jnp.clip(nodes, 0, num_classes - 2)
+    w = jnp.take(weight, nodes_c, axis=0)            # [B, depth, D]
+    logits = jnp.einsum('bd,bkd->bk', input, w)
+    if bias is not None:
+        logits = logits + jnp.take(jnp.reshape(bias, (-1,)), nodes_c, axis=0)
+    # BCE with sign from code
+    loss = jnp.log1p(jnp.exp(-jnp.where(codes > 0, logits, -logits)))
+    loss = jnp.where(valid, loss, 0.0)
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+@op
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction='mean'):
+    """ArcFace-style margin softmax. logits assumed cosine similarities."""
+    lbl = jnp.asarray(label).astype(jnp.int32).reshape(-1)
+    onehot = jax.nn.one_hot(lbl, logits.shape[-1], dtype=logits.dtype)
+    theta = jnp.arccos(jnp.clip(logits, -1 + 1e-7, 1 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, logits) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, lbl[:, None], axis=1)
+    if reduction == 'mean':
+        loss = jnp.mean(loss)
+    elif reduction == 'sum':
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted, axis=-1)
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (plus all positives)."""
+    import numpy as np
+    lbl = np.asarray(label._value if isinstance(label, Tensor) else label).reshape(-1)
+    pos = np.unique(lbl)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    np.random.shuffle(rest)
+    take = max(num_samples - len(pos), 0)
+    sampled = np.sort(np.concatenate([pos, rest[:take]]))
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.asarray([remap[c] for c in lbl], 'int64')
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled.astype('int64'))))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    out = elu(x, alpha)
+    x._replace_value(out._value)
+    return x
+
+
+def tanh_(x, name=None):
+    from .activation import tanh
+    out = tanh(x)
+    x._replace_value(out._value)
+    return x

@@ -1,0 +1,104 @@
+"""Quantization-aware training layers.
+
+Reference: python/paddle/nn/quant/ (FakeQuantAbsMax, QuantizedLinear/Conv2D
+in fluid contrib slim). TPU-native: fake-quant is a straight-through
+estimator expressed in jnp (int8 simulated in fp); real int8 serving comes
+from XLA's native int8 matmul when weights are pre-quantized.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op, apply_op
+from .layer_base import Layer
+from .layer_common import Linear
+from .layer_conv import Conv2D
+
+
+def _ste(x, q):
+    """straight-through: forward q, backward identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@op
+def fake_quantize_abs_max(x, bits=8, name=None):
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(x)) / qmax
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / scale) * scale
+    return _ste(x, q)
+
+
+@op
+def fake_channel_wise_quantize_abs_max(x, bits=8, axis=0, name=None):
+    qmax = 2.0 ** (bits - 1) - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True) / qmax
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / scale) * scale
+    return _ste(x, q)
+
+
+@op
+def fake_quantize_moving_average_abs_max(x, state_scale, bits=8, name=None):
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.asarray(state_scale) / qmax, 1e-8)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+    return _ste(x, q)
+
+
+class FakeQuantAbsMax(Layer):
+    def __init__(self, quant_bits=8, dtype='float32', name=None):
+        super().__init__()
+        self.bits = quant_bits
+
+    def forward(self, x):
+        return fake_quantize_abs_max(x, self.bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weights+activations (QAT)."""
+
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def forward(self, x):
+        from . import functional as F
+        xq = fake_quantize_abs_max(x, self.activation_bits)
+        wq = fake_channel_wise_quantize_abs_max(self.inner.weight,
+                                                self.weight_bits, axis=1)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def forward(self, x):
+        from . import functional as F
+        xq = fake_quantize_abs_max(x, self.activation_bits)
+        wq = fake_channel_wise_quantize_abs_max(self.inner.weight,
+                                                self.weight_bits, axis=0)
+        return F.conv2d(xq, wq, self.inner.bias,
+                        self.inner._stride, self.inner._padding,
+                        self.inner._dilation, self.inner._groups,
+                        self.inner._data_format)
+
+
+def quantize_model(model, weight_bits=8, activation_bits=8):
+    """Swap Linear/Conv2D sublayers for QAT-wrapped versions in place."""
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, Linear):
+            model._sub_layers[name] = QuantizedLinear(sub, weight_bits,
+                                                      activation_bits)
+        elif isinstance(sub, Conv2D):
+            model._sub_layers[name] = QuantizedConv2D(sub, weight_bits,
+                                                      activation_bits)
+        else:
+            quantize_model(sub, weight_bits, activation_bits)
+    return model

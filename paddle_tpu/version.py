@@ -1,0 +1,12 @@
+"""Version info. Reference: python/paddle/version.py (generated)."""
+full_version = '0.1.0'
+major = 0
+minor = 1
+patch = 0
+rc = 0
+istaged = True
+commit = 'dev'
+
+
+def show():
+    print(f'paddle_tpu {full_version} (commit {commit})')
