@@ -93,3 +93,21 @@ def set_grad_enabled(mode):
 def is_grad_enabled():
     from ..core.tensor import _grad_enabled
     return _grad_enabled()
+
+
+class PyLayerContext:
+    """Context object passed to PyLayer.forward/backward."""
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    @property
+    def saved_tensor(self):
+        return self.container
+
+
+def backward_mode():
+    return True
+
+
+no_grad_ = no_grad

@@ -16,6 +16,25 @@ from .topology import (  # noqa: F401
     HybridTopology, get_mesh, get_topology, set_topology)
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, set_offload_device, set_pipeline_stage, set_shard_mask,
+    shard_op, shard_tensor, split)
+from .fleet import utils  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from .entry_attr import CountFilterEntry, ProbabilityEntry  # noqa: F401
+from .ps_dataset import BoxPSDataset, InMemoryDataset, QueueDataset  # noqa: F401
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    pass
+
+
+def gloo_barrier():
+    pass
+
+
+def gloo_release():
+    pass
 
 
 def get_rank(group=None):

@@ -1,0 +1,100 @@
+"""Auto-parallel API: ProcessMesh + shard_tensor/shard_op.
+
+Reference: python/paddle/distributed/auto_parallel/ (interface.py:
+shard_tensor/shard_op with dims_mapping over ProcessMesh). TPU-native: these
+are literally jax.sharding concepts — ProcessMesh wraps a Mesh, shard_tensor
+is device_put/with_sharding_constraint with a PartitionSpec.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, parent=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f'd{i}' for i in range(arr.ndim)]
+        self.dim_names = list(dim_names)
+        self.topology = list(arr.shape)
+        self.processes = arr.reshape(-1).tolist()
+        devs = np.asarray(jax.devices()[:arr.size]).reshape(arr.shape)
+        self.jax_mesh = Mesh(devs, tuple(self.dim_names))
+
+    @property
+    def shape(self):
+        return self.topology
+
+    @property
+    def ndim(self):
+        return len(self.topology)
+
+
+def _spec_from_dims_mapping(mesh: ProcessMesh, dims_mapping):
+    axes = []
+    for d in dims_mapping:
+        axes.append(None if d == -1 else mesh.dim_names[d])
+    return PartitionSpec(*axes)
+
+
+def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None,
+                 dims_mapping=None):
+    """Place (or constrain) a tensor's sharding on the mesh."""
+    mesh = process_mesh or (dist_attr or {}).get('process_mesh')
+    dm = dims_mapping if dims_mapping is not None else \
+        (dist_attr or {}).get('dims_mapping')
+    if shard_spec is not None:
+        spec = PartitionSpec(*[None if s is None else s for s in shard_spec])
+    elif dm is not None and mesh is not None:
+        spec = _spec_from_dims_mapping(mesh, dm)
+    else:
+        spec = PartitionSpec()
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if isinstance(v, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(v, NamedSharding(jmesh, spec))
+    else:
+        try:
+            out = jax.device_put(v, NamedSharding(jmesh, spec))
+        except Exception:
+            out = v
+    if isinstance(x, Tensor):
+        x._replace_value(out)
+        return x
+    return Tensor(out)
+
+
+def shard_op(op_fn, dist_attr=None, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Wrap a callable so outputs get sharding constraints applied."""
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs and process_mesh is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            outs = [shard_tensor(o, process_mesh=process_mesh,
+                                 shard_spec=s)
+                    for o, s in zip(outs, out_shard_specs)]
+            return type(out)(outs) if isinstance(out, (list, tuple)) else outs[0]
+        return out
+    return wrapped
+
+
+def set_shard_mask(x, mask):
+    return x
+
+
+def set_offload_device(x, device):
+    return x
+
+
+def set_pipeline_stage(stage):
+    pass
+
+
+def split(x, num_or_sections, axis=0):
+    from ..tensor.manipulation import split as _split
+    return _split(x, num_or_sections, axis)

@@ -1,0 +1,11 @@
+"""Cloud env helpers. Reference: python/paddle/distributed/cloud_utils.py."""
+import os
+
+
+def get_cloud_cluster(args_node_ips=None, device_mode=None, devices_per_proc=None,
+                      args_port=None):
+    return None
+
+
+def get_trainers_num():
+    return int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))

@@ -1,0 +1,29 @@
+"""Sparse-feature entry attrs (parameter-server ecosystem).
+Reference: python/paddle/distributed/entry_attr.py."""
+
+
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError('probability must be in (0, 1]')
+        self._name = 'probability_entry'
+        self._probability = probability
+
+    def _to_attr(self):
+        return f'{self._name}:{self._probability}'
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError('count_filter must be >= 0')
+        self._name = 'count_filter_entry'
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f'{self._name}:{self._count_filter}'

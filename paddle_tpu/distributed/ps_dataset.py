@@ -1,0 +1,70 @@
+"""Parameter-server style streaming datasets.
+Reference: python/paddle/distributed/fleet/dataset/ (InMemoryDataset /
+QueueDataset over C++ feeders). TPU-native stand-ins backed by the native
+worker pool: files of pickled/text samples streamed through io.DataLoader.
+"""
+import os
+
+
+class _FileDatasetBase:
+    def __init__(self):
+        self._files = []
+        self._batch_size = 1
+        self._thread = 1
+        self._pipe_command = None
+        self._use_var = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, **kwargs):
+        self._batch_size = batch_size
+        self._thread = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._files = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = thread_num
+
+    def _iter_lines(self):
+        for path in self._files:
+            with open(path) as f:
+                yield from f
+
+
+class InMemoryDataset(_FileDatasetBase):
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+
+class QueueDataset(_FileDatasetBase):
+    pass
+
+
+class BoxPSDataset(InMemoryDataset):
+    def begin_pass(self):
+        pass
+
+    def end_pass(self, need_save_delta=False):
+        pass

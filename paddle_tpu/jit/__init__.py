@@ -220,3 +220,40 @@ def load(path, **configs):
     and ``set_state_dict``, or serve via inference.Predictor."""
     from ..framework_io import load as fload
     return fload(path + '.pdparams')
+
+
+# ---- parity shims (reference: python/paddle/jit/__init__.py) -------------
+declarative = to_static          # old alias
+TranslatedLayer = StaticFunction
+
+
+class ProgramTranslator:
+    """Reference: jit/dy2static/program_translator.py. Tracing-based backend
+    has no AST translator state; enable flag toggles to_static pass-through."""
+    _instance = None
+    enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        ProgramTranslator.enabled = bool(enable_to_static)
+
+
+def enable_to_static(flag):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+def set_code_level(level=100):
+    pass
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    pass
+
+
+class dy2static:
+    ProgramTranslator = ProgramTranslator

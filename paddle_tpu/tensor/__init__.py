@@ -13,6 +13,10 @@ from .random import (  # noqa: F401
     randn, randperm, seed, standard_normal, uniform)
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from .inplace_and_array import (  # noqa: F401
+    add_, array_length, array_read, array_write, ceil_, clip_, create_array,
+    exp_, flatten_, floor_, reciprocal_, round_, rsqrt_, scale_, sqrt_,
+    subtract_, uniform_)
 from .register import install as _install
 
 _install()

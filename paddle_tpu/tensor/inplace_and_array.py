@@ -1,0 +1,65 @@
+"""Module-level in-place op variants + TensorArray ops.
+Reference: python/paddle/tensor/__init__.py (_ suffixed ops) and
+python/paddle/tensor/array.py (LoDTensorArray ops used by static control flow).
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import math as _math
+from . import manipulation as _manip
+
+
+def _inplace(base):
+    def fn(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        x._replace_value(out._value)
+        return x
+    fn.__name__ = base.__name__ + '_'
+    return fn
+
+
+add_ = _inplace(_math.add)
+subtract_ = _inplace(_math.subtract)
+ceil_ = _inplace(_math.ceil)
+floor_ = _inplace(_math.floor)
+round_ = _inplace(_math.round)
+exp_ = _inplace(_math.exp)
+sqrt_ = _inplace(_math.sqrt)
+rsqrt_ = _inplace(_math.rsqrt)
+reciprocal_ = _inplace(_math.reciprocal)
+clip_ = _inplace(_math.clip)
+scale_ = _inplace(_math.scale)
+flatten_ = _inplace(_manip.flatten)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    from .random import uniform
+    out = uniform(x.shape, x.dtype, min=min, max=max)
+    x._replace_value(out._value)
+    return x
+
+
+# ---- TensorArray (list of tensors; static control-flow storage) ----------
+
+def create_array(dtype='float32', initialized_list=None):
+    arr = list(initialized_list) if initialized_list else []
+    return arr
+
+
+def array_write(x, i, array=None):
+    idx = int(i.item() if isinstance(i, Tensor) else i)
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.item() if isinstance(i, Tensor) else i)
+    return array[idx]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
