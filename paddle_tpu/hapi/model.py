@@ -86,19 +86,36 @@ class Model:
             for l in net.sublayers(include_self=True):
                 l.training = training
 
-        def step(params, buffers, opt_state, key, lr, inputs, labels):
+        def loss_and_grads(params, buffers, key, inputs, labels):
             def loss_fn(p):
                 with rng_scope(key):
                     set_mode(True)
                     out, new_buf = functional_call(net, p, buffers, *inputs)
                 loss = self._compute_loss(out, labels)
                 return loss, (out, new_buf)
-            (loss, (out, new_buf)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def step(params, buffers, opt_state, key, lr, inputs, labels):
+            (loss, (out, new_buf)), grads = loss_and_grads(
+                params, buffers, key, inputs, labels)
             new_params, new_state = opt.functional_apply(params, grads,
                                                          opt_state, lr)
             return loss, out, new_params, new_buf, new_state
 
+        def accum_step(params, buffers, grad_acc, key, inputs, labels):
+            """Gradient-merge micro-step: accumulate grads, no update.
+            Reference: fleet/meta_optimizers/gradient_merge_optimizer.py."""
+            (loss, (out, new_buf)), grads = loss_and_grads(
+                params, buffers, key, inputs, labels)
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            return loss, out, new_buf, grad_acc
+
+        def apply_accum(params, opt_state, grad_acc, lr, scale):
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grad_acc)
+            return opt.functional_apply(params, grads, opt_state, lr)
+
+        self._accum_step = jax.jit(accum_step)
+        self._apply_accum = jax.jit(apply_accum)
         return jax.jit(step)
 
     def _build_eval_step(self):
@@ -137,12 +154,36 @@ class Model:
         params = self._params_dict()
         buffers = self._buffers_dict()
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        if not update:
+            # gradient-merge micro step: accumulate into self._grad_acc
+            if getattr(self, '_grad_acc', None) is None:
+                self._grad_acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+                self._accum_count = 0
+            loss, out, new_b, self._grad_acc = self._accum_step(
+                params, buffers, self._grad_acc, next_key(),
+                tuple(inputs), tuple(labels))
+            self._accum_count += 1
+            self._write_back(params, new_b)
+            self._last_outputs = out
+            return [np.asarray(loss)]
+        if getattr(self, '_grad_acc', None) is not None:
+            # final micro step: accumulate then apply averaged grads
+            loss, out, new_b, self._grad_acc = self._accum_step(
+                params, buffers, self._grad_acc, next_key(),
+                tuple(inputs), tuple(labels))
+            self._accum_count += 1
+            new_p, self._opt_state = self._apply_accum(
+                params, self._opt_state, self._grad_acc, lr,
+                jnp.asarray(1.0 / self._accum_count, jnp.float32))
+            self._write_back(new_p, new_b)
+            self._grad_acc = None
+            self._last_outputs = out
+            return [np.asarray(loss)]
         loss, out, new_p, new_b, new_s = self._train_step(
             params, buffers, self._opt_state, next_key(), lr,
             tuple(inputs), tuple(labels))
-        if update:
-            self._write_back(new_p, new_b)
-            self._opt_state = new_s
+        self._write_back(new_p, new_b)
+        self._opt_state = new_s
         self._last_outputs = out
         return [np.asarray(loss)]
 
@@ -192,7 +233,8 @@ class Model:
             for step_idx, batch in enumerate(loader):
                 cbks.on_batch_begin('train', step_idx, logs)
                 inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)
+                do_update = (step_idx + 1) % accumulate_grad_batches == 0
+                loss = self.train_batch(inputs, labels, update=do_update)
                 logs = {'loss': float(loss[0]), 'step': step_idx}
                 self._update_metrics(logs, inputs, labels)
                 cbks.on_batch_end('train', step_idx, logs)
