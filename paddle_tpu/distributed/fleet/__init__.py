@@ -98,7 +98,22 @@ class _DistributedOptimizer:
         return state
 
     def functional_apply(self, params, grads, opt_state, lr=None):
-        return self._inner.functional_apply(params, grads, opt_state, lr)
+        stage = 1
+        if self._strategy and self._strategy.sharding:
+            stage = int(getattr(self._strategy.sharding_configs, 'stage', 1) or 1)
+        if stage < 2:
+            return self._inner.functional_apply(params, grads, opt_state, lr)
+        # ZeRO-2/3: constrain grads dp-sharded so XLA emits reduce-scatter;
+        # stage 3 additionally keeps params sharded (FSDP-style)
+        from ...parallel import zero
+        topo = get_topology()
+        axes = _zero_axes(topo)
+        grads = zero.constrain(grads, topo.mesh, axes)
+        new_p, new_s = self._inner.functional_apply(params, grads, opt_state, lr)
+        new_s = zero.constrain(new_s, topo.mesh, axes)   # keep ZeRO-1 layout
+        if stage >= 3:
+            new_p = zero.constrain(new_p, topo.mesh, axes)
+        return new_p, new_s
 
     def step(self):
         return self._inner.step()
@@ -111,30 +126,18 @@ def distributed_optimizer(optimizer, strategy=None):
     return _DistributedOptimizer(optimizer, strategy or get_strategy())
 
 
-def shard_opt_state(state, params):
-    """ZeRO-1: place each optimizer-state array sharded over the
-    sharding/dp axes on its largest divisible dimension."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    topo = get_topology()
-    mesh = topo.mesh
-    deg = topo.axis_size('sharding') * topo.axis_size('dp')
-    if deg <= 1:
-        return state
+def _zero_axes(topo):
+    return tuple(a for a in ('dp', 'sharding')
+                 if topo.axis_size(a) > 1) or ('dp',)
 
-    def place(x):
-        if not hasattr(x, 'shape') or x.ndim == 0:
-            return x
-        for d, s in enumerate(x.shape):
-            if s % deg == 0 and s >= deg:
-                axes = [None] * x.ndim
-                axes[d] = ('dp', 'sharding')
-                try:
-                    return jax.device_put(
-                        x, NamedSharding(mesh, PartitionSpec(*axes)))
-                except Exception:
-                    return x
-        return x
-    return jax.tree_util.tree_map(place, state)
+
+def shard_opt_state(state, params):
+    """ZeRO-1: place each optimizer-state array sharded over the sharding/dp
+    axes. Delegates to parallel.zero so init placement and the per-step
+    constraints in functional_apply agree on which dim is sharded."""
+    from ...parallel import zero
+    topo = get_topology()
+    return zero.place(state, topo.mesh, _zero_axes(topo))
 
 
 class RoleMakerBase:

@@ -187,6 +187,19 @@ class Model:
         self._last_outputs = out
         return [np.asarray(loss)]
 
+    def _flush_grad_acc(self):
+        """Apply any pending accumulated grads (partial gradient-merge cycle)."""
+        if getattr(self, '_grad_acc', None) is None:
+            return
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        params = self._params_dict()
+        new_p, self._opt_state = self._apply_accum(
+            params, self._opt_state, self._grad_acc, lr,
+            jnp.asarray(1.0 / max(self._accum_count, 1), jnp.float32))
+        self._write_back(new_p, self._buffers_dict())
+        self._grad_acc = None
+        self._accum_count = 0
+
     def eval_batch(self, inputs, labels=None):
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
@@ -241,6 +254,9 @@ class Model:
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     break
+            # flush a partial gradient-merge cycle so stale grads never leak
+            # into the next epoch (or a later fit call) with a wrong divisor
+            self._flush_grad_acc()
             from ..optimizer.lr import LRScheduler, ReduceOnPlateau
             if isinstance(self._optimizer._lr, LRScheduler) and \
                     not isinstance(self._optimizer._lr, ReduceOnPlateau):

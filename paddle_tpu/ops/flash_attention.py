@@ -23,6 +23,7 @@ except Exception:   # pragma: no cover
 
 _BQ = 256
 _BK = 256
+_LANES = 128   # TPU lane width; lse is stored lane-broadcast to tile cleanly
 
 
 def flash_attention_available(q, k, v, mask):
@@ -41,14 +42,22 @@ def flash_attention_available(q, k, v, mask):
             d in (64, 128, 256) and q.dtype in (jnp.float32, jnp.bfloat16))
 
 
+import numpy as _np
+_NEG_INF = _np.float32(-1e30)
+_EPS = _np.float32(1e-30)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
+    # All scalar constants pinned to f32: under jax_enable_x64 a bare Python
+    # float becomes an f64 constant, which Mosaic cannot legalize on TPU.
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    q = q_ref[0].astype(jnp.float32) * _np.float32(scale)   # [BQ, D]
     s_total = k_ref.shape[1]
     nkb = s_total // bk
     d = q.shape[-1]
 
     def body(kb, carry):
+        # carries kept 2-D ([BQ,1]) — Mosaic vectorizes 2-D ops cleanly
         acc, m, l = carry
         kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)   # [BK, D]
         vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
@@ -57,24 +66,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [BQ,1]
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)                                   # [BQ,1]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
             p, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
-    n_iter = nkb if not causal else (qi + 1) * (bq // bk)
+    # loop bounds pinned to i32: under jax_enable_x64 a Python-int bound makes
+    # the fori_loop index i64, which Mosaic rejects mixing with i32 scalars
+    n_iter = jnp.asarray(nkb if not causal else (qi + 1) * (bq // bk),
+                         jnp.int32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), -1e30, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), n_iter, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, _EPS)
     o_ref[0] = out.astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))
+    # TPU tiling: store lse broadcast across a 128-lane trailing dim
+    lse = m + jnp.log(jnp.maximum(l, _EPS))                          # [BQ,1]
+    lse_ref[0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
 def _flash_fwd(q, k, v, causal):
@@ -88,20 +102,20 @@ def _flash_fwd(q, k, v, causal):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, _np.int32(0))),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, _np.int32(0), _np.int32(0))),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, _np.int32(0), _np.int32(0))),
         ],
         out_specs=[
-            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, _np.int32(0))),
+            pl.BlockSpec((1, _BQ, _LANES), lambda b, i: (b, i, _np.int32(0))),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32),
         ],
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, 0]
 
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal):

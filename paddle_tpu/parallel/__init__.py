@@ -4,3 +4,5 @@ from .ring_attention import ring_attention, sequence_parallel_attention  # noqa:
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .moe import moe_ffn, top2_gating  # noqa: F401
 from .parallelize import make_sharded_train_step, shard_params  # noqa: F401
+from . import zero  # noqa: F401
+from .zero import make_zero_train_step  # noqa: F401

@@ -137,3 +137,21 @@ def test_hapi_save_load():
         m2.load(os.path.join(d, 'ckpt'))
         x = paddle.to_tensor(X[:2])
         assert np.allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
+def test_hapi_grad_accum_flushes_across_epochs():
+    """Partial gradient-merge cycles must flush at epoch end — no stale
+    accumulator may leak into the next epoch (regression test)."""
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    X = np.random.rand(10, 4).astype('float32')
+    Y = np.random.randint(0, 2, (10, 1)).astype('int64')
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    # 10 batches of 1 with accumulate=4 -> 2 leftover micro-steps per epoch
+    model.fit(ds, epochs=2, batch_size=1, verbose=0,
+              accumulate_grad_batches=4, shuffle=False)
+    assert getattr(model, '_grad_acc', None) is None
+    assert getattr(model, '_accum_count', 0) == 0
