@@ -113,6 +113,10 @@ class _DistributedOptimizer:
         new_s = zero.constrain(new_s, topo.mesh, axes)   # keep ZeRO-1 layout
         if stage >= 3:
             new_p = zero.constrain(new_p, topo.mesh, axes)
+        else:
+            # ZeRO-2 keeps params replicated: without this constraint GSPMD
+            # propagates the dp-sharded grad layout into the updated params
+            new_p = zero.replicate(new_p, topo.mesh)
         return new_p, new_s
 
     def step(self):

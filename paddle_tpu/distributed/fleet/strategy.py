@@ -23,7 +23,7 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = _Cfg(k_steps=1, avg=True)
         self.sharding = False
-        self.sharding_configs = _Cfg(sharding_degree=1, stage=2,
+        self.sharding_configs = _Cfg(sharding_degree=1, stage=1,
                                      segment_broadcast_MB=32)
         self.pipeline = False
         self.pipeline_configs = _Cfg(accumulate_steps=1, micro_batch_size=1,

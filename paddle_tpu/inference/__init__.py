@@ -7,7 +7,6 @@ params + StableHLO; Predictor AOT-compiles the forward with XLA once
 (Config controls precision/donation) and serves host arrays in/out. XLA's
 fusion/layout passes play the role of the reference's IR passes.
 """
-import json
 import os
 
 import jax
@@ -94,15 +93,12 @@ class Predictor:
         path = config.model_path
         if path.endswith('.pdmodel'):
             path = path[:-len('.pdmodel')]
-        from ..framework_io import load as fload
-
-        def _arr(v):
-            return jnp.asarray(getattr(v, '_value', v))
-        state = fload(path + '.pdparams')
-        self._params = {k: _arr(v) for k, v in state['params'].items()}
-        self._buffers = {k: _arr(v) for k, v in state['buffers'].items()}
-        with open(path + '.pdmodel') as f:
-            self._meta = json.load(f)
+        # Standalone serialized program (jax.export) written by jit.save lets
+        # the Predictor serve with no Python Layer at all, the way the
+        # reference's AnalysisPredictor runs the __model__ ProgramDesc.
+        from ..jit import load_saved_artifacts
+        self._params, self._buffers, self._meta, self._exec = \
+            load_saved_artifacts(path)
         self._input_names = [f'x{i}' for i in range(
             len(self._meta.get('input_spec', [])) or 1)]
         self._feed = {}
@@ -159,11 +155,30 @@ class Predictor:
         else:
             feed = [jnp.asarray(self._feed[n]) for n in self._input_names]
         if self._layer is None:
-            raise RuntimeError(
-                'Predictor needs attach_layer(model) in this runtime '
-                '(StableHLO interpreter-free serving); see docs/inference.md')
-        key = tuple((tuple(f.shape), str(f.dtype)) for f in feed)
-        out = self._get_compiled(key)(*feed)
+            if self._exec is None:
+                raise RuntimeError(
+                    'model was saved without a standalone program (.pdexec); '
+                    'call attach_layer(model) or re-export with jit.save')
+            if self.config._precision != PrecisionType.Float32:
+                import warnings
+                warnings.warn(
+                    'Config precision is ignored when serving the exported '
+                    'program (dtypes are pinned at jit.save); attach_layer() '
+                    'to serve at a different precision', stacklevel=2)
+            if not self._meta.get('poly_batch', False):
+                spec = self._meta.get('input_spec', [])
+                for f, s in zip(feed, spec):
+                    want = [1 if d == -1 else d for d in s['shape']]
+                    if list(f.shape) != want:
+                        raise ValueError(
+                            f'saved program was exported with fixed input '
+                            f'shape {want} (shape polymorphism unavailable '
+                            f'for this model); got {list(f.shape)}. '
+                            f'attach_layer(model) for dynamic shapes.')
+            out = self._exec.call(self._params, self._buffers, *feed)
+        else:
+            key = tuple((tuple(f.shape), str(f.dtype)) for f in feed)
+            out = self._get_compiled(key)(*feed)
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs = [np.asarray(o) for o in outs]
         self._output_names = [f'out{i}' for i in range(len(outs))]

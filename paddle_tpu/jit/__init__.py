@@ -203,10 +203,71 @@ def save(layer, path, input_spec=None, **configs):
         def infer_fn(*xs):
             out, _ = functional_call(layer, pd, bd, *xs)
             return out
+
+        def infer_fn_functional(params, buffers, *xs):
+            out, _ = functional_call(layer, params, buffers, *xs)
+            return out
         try:
             lowered = jax.jit(infer_fn).lower(*examples)
             with open(path + '.stablehlo', 'w') as f:
                 f.write(lowered.as_text())
+            # Standalone serialized program (jax.export): the portable
+            # analogue of the reference's __model__ ProgramDesc — the
+            # Predictor deserializes and runs it WITHOUT the Python Layer.
+            # Dims marked -1/None in the InputSpec become symbolic so one
+            # artifact serves any size along those axes. Tried in order:
+            # one symbol per dynamic dim (fully independent), one shared
+            # symbol (programs that require equal dynamic dims, e.g. two
+            # inputs added together), then fully concrete example shapes.
+            meta['exported'] = False
+            meta['poly_batch'] = False
+            from jax import export as jax_export
+            p_struct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), pd)
+            b_struct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bd)
+
+            def _sym_specs(shared):
+                n_dyn = sum(1 for s in specs for d in s.shape
+                            if d is None or d == -1)
+                if n_dyn == 0:
+                    return None, False
+                names = 'b' if shared else ', '.join(
+                    f'b{i}' for i in range(n_dyn))
+                syms = list(jax_export.symbolic_shape(names))
+                it = iter(syms * n_dyn if shared else syms)
+                out = []
+                for s in specs:
+                    dims = [next(it) if (d is None or d == -1) else int(d)
+                            for d in s.shape]
+                    out.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+                return out, True
+
+            n_dyn_total = sum(1 for s in specs for d in s.shape
+                              if d is None or d == -1)
+            attempts = []
+            for shared in ((False, True) if n_dyn_total > 1 else (False,)):
+                ss, poly = _sym_specs(shared)
+                if ss is not None:
+                    attempts.append((ss, poly))
+                if not poly:
+                    break
+            attempts.append(([jax.ShapeDtypeStruct(e.shape, e.dtype)
+                              for e in examples], False))
+            for in_specs, poly in attempts:
+                try:
+                    exported = jax_export.export(jax.jit(infer_fn_functional))(
+                        p_struct, b_struct, *in_specs)
+                    blob = exported.serialize()
+                except Exception:
+                    continue
+                with open(path + '.pdexec', 'wb') as f:
+                    f.write(blob)
+                meta['exported'] = True
+                meta['poly_batch'] = poly
+                break
+            if not meta['exported'] and os.path.exists(path + '.pdexec'):
+                os.unlink(path + '.pdexec')   # drop stale program from prior save
         finally:
             if was_training:
                 layer.train()
@@ -215,16 +276,83 @@ def save(layer, path, input_spec=None, **configs):
         json.dump(meta, f)
 
 
+def load_saved_artifacts(path):
+    """Load a jit.save'd prefix: (params, buffers, meta, exec_or_None).
+
+    The serialized program is only deserialized when meta says the export
+    succeeded — a stale .pdexec from an earlier save of a different model is
+    ignored. Shared by jit.load and inference.Predictor.
+    """
+    import json
+    from ..framework_io import load as fload
+    state = fload(path + '.pdparams')
+
+    def _arr(v):
+        return jnp.asarray(getattr(v, '_value', v))
+    params = {k: _arr(v) for k, v in state['params'].items()}
+    buffers = {k: _arr(v) for k, v in state['buffers'].items()}
+    with open(path + '.pdmodel') as f:
+        meta = json.load(f)
+    executable = None
+    if meta.get('exported') and os.path.exists(path + '.pdexec'):
+        from jax import export as jax_export
+        with open(path + '.pdexec', 'rb') as f:
+            executable = jax_export.deserialize(f.read())
+    return params, buffers, meta, executable
+
+
+class TranslatedLayer:
+    """A jit.save'd program reloaded WITHOUT its Python class.
+
+    Reference: fluid/dygraph/io.py TranslatedLayer (rebuilds a Layer from the
+    __model__ ProgramDesc). Here the program is a serialized jax.export
+    artifact (.pdexec): deserialization gives a callable XLA program; params
+    and buffers come from the .pdparams archive and are passed as the leading
+    pytree arguments.
+    """
+
+    def __init__(self, path):
+        self._params, self._buffers, self._meta, self._exec = \
+            load_saved_artifacts(path)
+        if self._exec is None:
+            raise RuntimeError(
+                f'{path}.pdexec missing or export failed at save time; '
+                f'reconstruct the Layer and set_state_dict(jit.load raw dict)')
+
+    def forward(self, *inputs):
+        arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(np.asarray(a))
+                  for a in inputs]
+        out = self._exec.call(self._params, self._buffers, *arrays)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    __call__ = forward
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError('TranslatedLayer is inference-only '
+                           '(re-train from the original Layer)')
+
+    def state_dict(self):
+        return {**self._params, **self._buffers}
+
+
 def load(path, **configs):
-    """Returns the saved state dict {params, buffers}. Reconstruct the Layer
-    and ``set_state_dict``, or serve via inference.Predictor."""
+    """Reload a jit.save'd model. Returns a callable TranslatedLayer when the
+    standalone program (.pdexec) exists; otherwise the raw state dict
+    {params, buffers} for manual ``set_state_dict``."""
+    if os.path.exists(path + '.pdexec') and os.path.exists(path + '.pdmodel'):
+        import json
+        with open(path + '.pdmodel') as f:
+            if json.load(f).get('exported'):
+                return TranslatedLayer(path)
     from ..framework_io import load as fload
     return fload(path + '.pdparams')
 
 
 # ---- parity shims (reference: python/paddle/jit/__init__.py) -------------
 declarative = to_static          # old alias
-TranslatedLayer = StaticFunction
 
 
 class ProgramTranslator:

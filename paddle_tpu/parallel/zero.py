@@ -64,6 +64,13 @@ def constrain(tree, mesh=None, axes=('dp',)):
     return _constrain(tree, mesh, zero_specs(tree, mesh, axes))
 
 
+def replicate(tree, mesh=None):
+    """with_sharding_constraint every leaf fully replicated (trace-time)."""
+    mesh = mesh or get_mesh()
+    return _constrain(tree, mesh, jax.tree_util.tree_map(
+        lambda _: PartitionSpec(), tree))
+
+
 def place(tree, mesh=None, axes=('dp',)):
     """device_put a pytree per its ZeRO specs (host-side placement)."""
     mesh = mesh or get_mesh()

@@ -12,7 +12,12 @@ def _static_shape(shape):
         if isinstance(s, Tensor):
             out.append(int(np.asarray(s._value)))
         else:
-            out.append(int(s))
+            from jax import export as _jax_export
+            if _jax_export.is_symbolic_dim(s):
+                # jax.export shape polymorphism — pass through unresolved
+                out.append(s)
+            else:
+                out.append(int(s))
     return tuple(out)
 
 

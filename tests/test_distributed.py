@@ -239,3 +239,6 @@ def test_zero_stage2_fleet_strategy():
         lambda p, g, s: opt.functional_apply(p, g, s, jnp.asarray(1e-3)))(
             params, grads, state)
     assert jnp.all(jnp.isfinite(new_p['w']))
+    # stage 2 keeps params replicated (only grads/opt-state are sharded) —
+    # the dp-sharded grad layout must not propagate into the updated params
+    assert new_p['w'].sharding.is_fully_replicated

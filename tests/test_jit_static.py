@@ -85,6 +85,44 @@ def test_jit_save_load_predict():
         assert np.allclose(out2, ref, rtol=1e-4)
 
 
+def test_jit_save_standalone_exec_and_translated_layer():
+    """Layer-free serving: .pdexec (serialized jax.export program) serves any
+    batch size via a symbolic batch dim; no attach_layer / class needed."""
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return nn.functional.relu(self.fc(x))
+
+    with tempfile.TemporaryDirectory() as d:
+        net = Net()
+        net.eval()
+        path = os.path.join(d, 'standalone')
+        spec = [paddle.static.InputSpec([None, 4], 'float32')]
+        paddle.jit.save(net, path, input_spec=spec)
+        assert os.path.exists(path + '.pdexec')
+
+        w = np.asarray(net.fc.weight.numpy())
+        b = np.asarray(net.fc.bias.numpy())
+
+        # Predictor with NO attach_layer, two different batch sizes
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(path + '.pdmodel'))
+        for bs in (2, 5):
+            x = np.random.rand(bs, 4).astype('float32')
+            (out,) = pred.run([x])
+            assert np.allclose(out, np.maximum(x @ w + b, 0), rtol=1e-4)
+
+        # jit.load returns a callable TranslatedLayer
+        loaded = paddle.jit.load(path)
+        x = np.random.rand(3, 4).astype('float32')
+        out = loaded(paddle.to_tensor(x))
+        assert np.allclose(out.numpy(), np.maximum(x @ w + b, 0), rtol=1e-4)
+        assert 'fc.weight' in loaded.state_dict()
+
+
 def test_static_program_executor():
     paddle.enable_static()
     try:
