@@ -343,10 +343,11 @@ def load(path, **configs):
     standalone program (.pdexec) exists; otherwise the raw state dict
     {params, buffers} for manual ``set_state_dict``."""
     if os.path.exists(path + '.pdexec') and os.path.exists(path + '.pdmodel'):
-        import json
-        with open(path + '.pdmodel') as f:
-            if json.load(f).get('exported'):
-                return TranslatedLayer(path)
+        try:
+            # load_saved_artifacts makes the exported/stale decision itself
+            return TranslatedLayer(path)
+        except RuntimeError:
+            pass      # export failed at save time -> fall back to raw dict
     from ..framework_io import load as fload
     return fload(path + '.pdparams')
 

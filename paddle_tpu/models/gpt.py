@@ -44,12 +44,20 @@ class GPTConfig:
     dtype: str = 'bfloat16'
     param_dtype: str = 'float32'
     remat: bool = True
+    # 'full': recompute everything (min memory); 'dots': save matmul/flash
+    # outputs, recompute only cheap elementwise (near-full speed, ~matmul
+    # activations memory) — the TPU sweet spot since MXU results are the
+    # expensive thing to recompute and HBM is better spent on them
+    remat_policy: str = 'full'
     use_flash: bool = True
     # parallel degrees (must multiply to the mesh size together with dp)
     mp: int = 1
     pp: int = 1
     sp: int = 1
     n_microbatches: int = 1
+    # 'gpipe': fwd scan + autodiff reverse pipeline (stores O(m) stage inputs)
+    # '1f1b':  fused fwd/bwd schedule, O(pp) in-flight activations
+    pp_schedule: str = 'gpipe'
 
     @property
     def head_dim(self):
@@ -110,6 +118,15 @@ def param_specs(config: GPTConfig):
             'lnf_g': P(None), 'lnf_b': P(None)}
 
 
+def _remat(body, config):
+    """Apply the configured rematerialisation policy to a block body."""
+    if config.remat_policy == 'dots':
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(body)
+
+
 def _layer_norm(x, g, b, eps=1e-5):
     m = jnp.mean(x, axis=-1, keepdims=True)
     v = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
@@ -148,24 +165,32 @@ def block_fn(bp, x, config, explicit_mp=False):
     mp = config.mp if explicit_mp else 1
     nh, hd = config.num_heads // mp, config.head_dim
 
+    if mp > 1:
+        from ..parallel.tp_ad import f_identity, g_allreduce
+
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    if mp > 1:
+        y = f_identity(y, 'mp')
     qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, nh, hd)
-    k = k.reshape(B, S, nh, hd)
-    v = v.reshape(B, S, nh, hd)
+    # head-major packing [q_i|k_i|v_i] per head: an 'mp' column shard is then
+    # exactly that rank's heads (contiguous [Q|K|V] thirds would hand each
+    # rank a mix of Q and K columns)
+    qkv = qkv.reshape(B, S, nh, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
     a = _attention(q, k, v, config).reshape(B, S, h // mp)
     a = a @ bp['proj_w'].astype(cdt)
     if mp > 1:
-        a = jax.lax.psum(a, 'mp')
+        a = g_allreduce(a, 'mp')
     x = x + a + bp['proj_b'].astype(cdt)
 
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+    if mp > 1:
+        y = f_identity(y, 'mp')
     y = y @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt)
     y = jax.nn.gelu(y)
     y = y @ bp['out_w'].astype(cdt)
     if mp > 1:
-        y = jax.lax.psum(y, 'mp')
+        y = g_allreduce(y, 'mp')
     x = x + y + bp['out_b'].astype(cdt)
     return x
 
@@ -180,7 +205,7 @@ def forward(params, tokens, config: GPTConfig):
 
     body = partial(block_fn, config=config)
     if config.remat:
-        body = jax.checkpoint(body)
+        body = _remat(body, config)
 
     def scan_body(carry, bp):
         return body(bp, carry), None
@@ -229,6 +254,9 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
 
     explicit_mp = config.mp > 1
 
+    if config.pp > 1 and config.pp_schedule == '1f1b':
+        return _make_train_step_1f1b(config, optimizer, mesh, explicit_mp)
+
     def spmd_loss(params, tokens, targets):
         cdt = jnp.dtype(config.dtype)
         B, S = tokens.shape
@@ -239,7 +267,7 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
 
         body = partial(block_fn, config=config, explicit_mp=explicit_mp)
         if config.remat:
-            body = jax.checkpoint(body)
+            body = _remat(body, config)
 
         def scan_body(c, bp):
             return body(bp, c), None
@@ -259,50 +287,108 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         loss = -jnp.mean(ll)
         if config.pp > 1:
-            # head/loss are only valid on the last stage; mask + psum keeps
-            # both the value correct and the head grads un-duplicated
-            loss = jax.lax.psum(
-                jnp.where(last_stage_mask('pp'), loss, 0.0), 'pp')
-        loss = jax.lax.pmean(loss, 'dp')
-        if config.sp > 1:
-            loss = jax.lax.pmean(loss, 'sp')
+            # head/loss are only valid on the last stage; the psum over 'pp'
+            # happens AFTER the vjp (in spmd_valgrad) so no collective with an
+            # ambiguous transpose sits inside the differentiated region
+            loss = jnp.where(last_stage_mask('pp'), loss, 0.0)
         return loss
 
-    pp, mp = ('pp' if config.pp > 1 else None), ('mp' if explicit_mp else None)
-    blocks_spec = {
-        'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
-        'qkv_w': P(pp, None, mp), 'qkv_b': P(pp, mp),
-        'proj_w': P(pp, mp, None), 'proj_b': P(pp, None),
-        'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
-        'fc_w': P(pp, None, mp), 'fc_b': P(pp, mp),
-        'out_w': P(pp, mp, None), 'out_b': P(pp, None),
-    }
-    pspec_tree = {'wte': P(None, None), 'wpe': P(None, None),
-                  'blocks': blocks_spec, 'lnf_g': P(None), 'lnf_b': P(None)}
+    def spmd_valgrad(params, tokens, targets):
+        """value+grad INSIDE shard_map: the only collectives the vjp sees are
+        ppermute (pipeline/ring — exact inverse-permutation transpose) and the
+        custom-vjp Megatron f/g pair, so grads are exact per rank. Cross-rank
+        reductions are applied explicitly afterwards."""
+        loss, grads = jax.value_and_grad(
+            lambda p: spmd_loss(p, tokens, targets))(params)
+        if config.pp > 1:
+            # shared (non-block) params: embedding grads live on stage 0,
+            # head grads on the last stage → assemble across stages
+            loss = jax.lax.psum(loss, 'pp')
+            grads = {k: (v if k == 'blocks' else
+                         jax.tree_util.tree_map(
+                             lambda g: jax.lax.psum(g, 'pp'), v))
+                     for k, v in grads.items()}
+        reduce_axes = ['dp'] + (['sp'] if config.sp > 1 else [])
+        for ax in reduce_axes:
+            loss = jax.lax.pmean(loss, ax)
+            grads = jax.tree_util.tree_map(
+                lambda g, _ax=ax: jax.lax.pmean(g, _ax), grads)
+        return loss, grads
+
+    pspec_tree = train_specs(config)
     data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
 
-    smapped = shard_map(spmd_loss, mesh=mesh,
+    smapped = shard_map(spmd_valgrad, mesh=mesh,
                         in_specs=(pspec_tree, data_spec, data_spec),
-                        out_specs=P(), check_rep=False)
-
-    def _fix_replicated_grads(grads):
-        """Params replicated across mp have their compute duplicated on every
-        mp rank; shard_map's backward sums replicas → rescale by 1/mp."""
-        if not explicit_mp:
-            return grads
-        inv = 1.0 / config.mp
-
-        def scale(g, spec):
-            has_mp = any((a == 'mp' or (isinstance(a, tuple) and 'mp' in a))
-                         for a in spec if a is not None)
-            return g if has_mp else g * inv
-        return jax.tree_util.tree_map(scale, grads, pspec_tree,
-                                      is_leaf=lambda x: isinstance(x, jax.Array))
+                        out_specs=(P(), pspec_tree), check_rep=False)
 
     def step(params, opt_state, key, lr, tokens, targets):
-        loss, grads = jax.value_and_grad(
-            lambda p: smapped(p, tokens, targets))(params)
-        grads = _fix_replicated_grads(grads)
+        loss, grads = smapped(params, tokens, targets)
+        new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
+        return loss, new_p, new_s
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def _make_train_step_1f1b(config: GPTConfig, optimizer, mesh, explicit_mp):
+    """Fused 1F1B pipeline train step: manual fwd+bwd via
+    parallel.pipeline.pipeline_train_1f1b (O(pp) in-flight activations), no
+    outer jax.grad. Reference: fleet pipeline_parallel.py 1F1B scheduler."""
+    from jax.experimental.shard_map import shard_map
+    from ..parallel.pipeline import pipeline_train_1f1b
+
+    shared_keys = ('wte', 'wpe', 'lnf_g', 'lnf_b')
+
+    def spmd_grads(params, tokens, targets):
+        cdt = jnp.dtype(config.dtype)
+        shared = {k: params[k] for k in shared_keys}
+
+        def embed_fn(sh, tok):
+            S = tok.shape[1]
+            sp_idx = jax.lax.axis_index('sp') if config.sp > 1 else 0
+            pos = sp_idx * S + jnp.arange(S)
+            return (jnp.take(sh['wte'], tok, axis=0)
+                    + sh['wpe'][pos]).astype(cdt)
+
+        body = partial(block_fn, config=config, explicit_mp=explicit_mp)
+        if config.remat:
+            body = _remat(body, config)
+
+        def stage_fn(stage_params, xx):
+            out, _ = jax.lax.scan(lambda c, bp: (body(bp, c), None),
+                                  xx, stage_params)
+            return out
+
+        def head_fn(sh, h, tgt):
+            x = _layer_norm(h, sh['lnf_g'], sh['lnf_b']).astype(cdt)
+            logits = x @ sh['wte'].T.astype(cdt)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            return -jnp.mean(ll)
+
+        loss, g_blocks, g_shared = pipeline_train_1f1b(
+            stage_fn, embed_fn, head_fn, params['blocks'], shared,
+            tokens, targets, config.n_microbatches, axis_name='pp')
+
+        grads = dict(g_shared)
+        grads['blocks'] = g_blocks
+        loss = jax.lax.pmean(loss, 'dp')
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, 'dp'), grads)
+        if config.sp > 1:
+            loss = jax.lax.pmean(loss, 'sp')
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, 'sp'), grads)
+        return loss, grads
+
+    pspec_tree = train_specs(config)
+    data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
+    smapped = shard_map(spmd_grads, mesh=mesh,
+                        in_specs=(pspec_tree, data_spec, data_spec),
+                        out_specs=(P(), pspec_tree), check_rep=False)
+
+    def step(params, opt_state, key, lr, tokens, targets):
+        loss, grads = smapped(params, tokens, targets)
         new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
         return loss, new_p, new_s
 

@@ -1,7 +1,8 @@
 """TPU parallelism engine: ring attention, pipeline schedule, MoE dispatch,
 sharded train-step builder."""
 from .ring_attention import ring_attention, sequence_parallel_attention  # noqa: F401
-from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pipeline import (pipeline_apply, pipeline_train_1f1b,  # noqa: F401
+                       stack_stage_params)
 from .moe import moe_ffn, top2_gating  # noqa: F401
 from .parallelize import make_sharded_train_step, shard_params  # noqa: F401
 from . import zero  # noqa: F401

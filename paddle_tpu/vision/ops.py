@@ -171,17 +171,153 @@ def box_coder(prior_box, prior_box_var, target_box, code_type='encode_center_siz
     return jnp.stack([x1, y1, x2, y2], axis=-1)
 
 
+@op
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
-    raise NotImplementedError('psroi_pool: planned (round 2)')
+    """Position-sensitive ROI pooling (R-FCN).
+
+    Reference: paddle/fluid/operators/psroi_pool_op.h — rounded ROI corners,
+    [floor, ceil) integer bin extents, average over cells of input channel
+    (c*oh + i)*ow + j. TPU-native: separable membership masks over H and W
+    turn the data-dependent bin loops into one static einsum per ROI (vmapped)
+    — no dynamic shapes, whole thing stays jittable.
+
+    x: [N, C, H, W] with C = output_channels*oh*ow; boxes: [R, 4] (x1,y1,x2,y2);
+    boxes_num: [N]. Returns [R, output_channels, oh, ow].
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    N, C, H, W = x.shape
+    assert C % (oh * ow) == 0, 'channels must be divisible by oh*ow'
+    C0 = C // (oh * ow)
+    R = boxes.shape[0]
+    boxes_num = jnp.asarray(boxes_num)
+    box_batch = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=R)
+
+    x1 = jnp.round(boxes[:, 0]) * spatial_scale
+    y1 = jnp.round(boxes[:, 1]) * spatial_scale
+    x2 = (jnp.round(boxes[:, 2]) + 1.0) * spatial_scale
+    y2 = (jnp.round(boxes[:, 3]) + 1.0) * spatial_scale
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    bin_h = rh / oh                                       # [R]
+    bin_w = rw / ow
+
+    def one_roi(r):
+        feat = x[box_batch[r]].reshape((C0, oh, ow, H, W))
+        hstart = jnp.floor(jnp.arange(oh) * bin_h[r] + y1[r])      # [oh]
+        hend = jnp.ceil((jnp.arange(oh) + 1) * bin_h[r] + y1[r])
+        wstart = jnp.floor(jnp.arange(ow) * bin_w[r] + x1[r])      # [ow]
+        wend = jnp.ceil((jnp.arange(ow) + 1) * bin_w[r] + x1[r])
+        hstart = jnp.clip(hstart, 0, H)
+        hend = jnp.clip(hend, 0, H)
+        wstart = jnp.clip(wstart, 0, W)
+        wend = jnp.clip(wend, 0, W)
+        hh = jnp.arange(H)[None, :]
+        ww = jnp.arange(W)[None, :]
+        my = ((hh >= hstart[:, None]) & (hh < hend[:, None])).astype(x.dtype)
+        mx = ((ww >= wstart[:, None]) & (ww < wend[:, None])).astype(x.dtype)
+        total = jnp.einsum('cijhw,ih,jw->cij', feat, my, mx)
+        cnt = my.sum(-1)[:, None] * mx.sum(-1)[None, :]            # [oh, ow]
+        return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1.0), 0.0)
+
+    return jax.vmap(one_roi)(jnp.arange(R))
 
 
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@op
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None):
-    """Deformable conv v1/v2 via grid_sample gather (compile-friendly)."""
-    from ..nn.functional.common import grid_sample  # noqa — future use
-    raise NotImplementedError('deform_conv2d: planned (round 2)')
+    """Deformable convolution v1 (mask=None) / v2 (modulated).
+
+    Reference: python/paddle/vision/ops.py deform_conv2d →
+    paddle/fluid/operators/deformable_conv_op.* (CUDA modulated im2col).
+    TPU-native: build the deformed im2col columns with one batched bilinear
+    gather, then contract with the filter as a single grouped matmul so the
+    FLOPs land on the MXU.
+
+    x: [N, C, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo] ((dy, dx) interleaved
+    per kernel point); mask: [N, dg*kh*kw, Ho, Wo]; weight: [Co, C/g, kh, kw].
+    """
+    N, C, H, W = x.shape
+    Co, Cg, kh, kw = weight.shape
+    dg = deformable_groups
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    K = kh * kw
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    # sampling positions: base grid + kernel-point offset + learned offset
+    base_y = (jnp.arange(Ho) * sh - ph).astype(x.dtype)            # [Ho]
+    base_x = (jnp.arange(Wo) * sw - pw).astype(x.dtype)            # [Wo]
+    ky = (jnp.arange(kh) * dh).astype(x.dtype)
+    kx = (jnp.arange(kw) * dw).astype(x.dtype)
+    kyx = jnp.stack(jnp.meshgrid(ky, kx, indexing='ij'), -1).reshape(K, 2)
+    off = offset.reshape((N, dg, K, 2, Ho, Wo))
+    py = base_y[None, None, None, :, None] + kyx[None, None, :, 0, None, None] \
+        + off[:, :, :, 0]                                          # [N,dg,K,Ho,Wo]
+    px = base_x[None, None, None, None, :] + kyx[None, None, :, 1, None, None] \
+        + off[:, :, :, 1]
+
+    # bilinear gather with zero padding outside the image
+    xg = x.reshape((N, dg, C // dg, H * W))
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+    cols = 0.
+    for yy, wyy in ((y0, 1 - wy), (y0 + 1, wy)):
+        for xx, wxx in ((x0, 1 - wx), (x0 + 1, wx)):
+            valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            idx = (yi * W + xi).reshape((N, dg, 1, K * Ho * Wo))
+            v = jnp.take_along_axis(
+                xg, jnp.broadcast_to(idx, (N, dg, C // dg, K * Ho * Wo)),
+                axis=3).reshape((N, dg, C // dg, K, Ho, Wo))
+            w = (wyy * wxx * valid.astype(x.dtype))[:, :, None]
+            cols = cols + v * w
+
+    if mask is not None:
+        cols = cols * mask.reshape((N, dg, 1, K, Ho, Wo))
+
+    # grouped contraction: cols [N, g, C/g, K, Ho, Wo] x w [g, Co/g, C/g, K]
+    cols = cols.reshape((N, groups, C // groups, K, Ho, Wo))
+    wg = weight.reshape((groups, Co // groups, Cg, K))
+    out = jnp.einsum('ngckhw,gock->ngohw', cols, wg)
+    out = out.reshape((N, Co, Ho, Wo))
+    if bias is not None:
+        out = out + jnp.asarray(bias)[None, :, None, None]
+    return out
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError('DeformConv2D: planned (round 2)')
+from ..nn.layer_base import Layer as _Layer  # noqa: E402 (after op defs)
+
+
+class DeformConv2D(_Layer):
+    """Deformable conv layer. Reference: python/paddle/vision/ops.py
+    DeformConv2D. forward(x, offset, mask=None)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        ks = _pair(kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups, groups=groups)
+        fan_in = (in_channels // groups) * ks[0] * ks[1]
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks, weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._attrs)

@@ -101,6 +101,61 @@ def test_vision_ops_nms_roi():
     assert out.shape == [1, 4, 4, 4]
 
 
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets (and mask=1) deformable conv == plain conv."""
+    from paddle_tpu.vision.ops import deform_conv2d
+    import paddle_tpu.nn.functional as F
+    np.random.seed(0)
+    x = paddle.to_tensor(np.random.randn(2, 4, 8, 8).astype('float32'))
+    w = paddle.to_tensor(np.random.randn(6, 4, 3, 3).astype('float32'))
+    off = paddle.to_tensor(np.zeros((2, 2 * 9, 8, 8), 'float32'))
+    out = deform_conv2d(x, off, w, stride=1, padding=1)
+    ref = F.conv2d(x, w, stride=1, padding=1)
+    assert np.allclose(out.numpy(), ref.numpy(), atol=1e-4)
+    # v2 with mask=0.5 halves the output
+    m = paddle.to_tensor(np.full((2, 9, 8, 8), 0.5, 'float32'))
+    out2 = deform_conv2d(x, off, w, mask=m, stride=1, padding=1)
+    assert np.allclose(out2.numpy(), 0.5 * ref.numpy(), atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_integer_shift():
+    from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d
+    np.random.seed(1)
+    # a uniform offset of exactly (0, 1) shifts sampling one pixel right:
+    # 1x1 kernel, no padding -> out[..., j] == x[..., j+1]
+    x = paddle.to_tensor(np.random.randn(1, 1, 5, 6).astype('float32'))
+    w = paddle.to_tensor(np.ones((1, 1, 1, 1), 'float32'))
+    off = np.zeros((1, 2, 5, 6), 'float32')
+    off[:, 1] = 1.0                      # dx = 1
+    out = deform_conv2d(x, paddle.to_tensor(off), w).numpy()[0, 0]
+    xn = x.numpy()[0, 0]
+    assert np.allclose(out[:, :-1], xn[:, 1:], atol=1e-5)
+    assert np.allclose(out[:, -1], 0.0, atol=1e-5)   # sampled outside -> 0
+
+    layer = DeformConv2D(4, 8, 3, padding=1)
+    xx = paddle.randn([2, 4, 8, 8])
+    offs = paddle.to_tensor(np.zeros((2, 18, 8, 8), 'float32'))
+    y = layer(xx, offs)
+    assert y.shape == [2, 8, 8, 8]
+
+
+def test_psroi_pool():
+    from paddle_tpu.vision.ops import psroi_pool
+    # channel (c*oh + i)*ow + j holds constant value c*100 + i*10 + j:
+    # output bin (i, j) of channel c must read exactly that value
+    oh = ow = 2
+    C0 = 3
+    vals = np.arange(C0)[:, None, None] * 100 + \
+        np.arange(oh)[None, :, None] * 10 + np.arange(ow)[None, None, :]
+    x = np.broadcast_to(vals.reshape(C0 * oh * ow, 1, 1),
+                        (C0 * oh * ow, 8, 8)).astype('float32')[None]
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], 'float32'))
+    out = psroi_pool(paddle.to_tensor(x), boxes,
+                     paddle.to_tensor(np.array([1], 'int32')), 2)
+    assert out.shape == [1, 3, 2, 2]
+    assert np.allclose(out.numpy()[0], vals, atol=1e-5)
+
+
 def test_signal_stft_istft():
     x = paddle.randn([512])
     S = paddle.signal.stft(x, n_fft=128, hop_length=32)
