@@ -67,21 +67,80 @@ class Imdb(Dataset):
 
 
 class Imikolov(Dataset):
-    """PTB-style n-gram LM dataset."""
+    """PTB-style n-gram LM dataset.
+
+    Real files: the reference's simple-examples.tgz with
+    ./simple-examples/data/ptb.{train,valid,test}.txt (reference:
+    python/paddle/text/datasets/imikolov.py). Word dict built from
+    train+valid with min_word_freq cutoff, '<unk>' last.
+    """
 
     def __init__(self, data_file=None, data_type='NGRAM', window_size=5,
                  mode='train', min_word_freq=50, download=True):
         self.window_size = window_size
-        rng = np.random.RandomState(2 if mode == 'train' else 3)
-        vocab = 300
-        self.word_idx = {f'w{i}': i for i in range(vocab)}
-        n = 2048 if mode == 'train' else 256
-        stream = rng.randint(0, vocab, n + window_size)
-        self.samples = [stream[i:i + window_size].astype('int64')
-                        for i in range(n)]
+        self.data_type = data_type
+        data_file = data_file or os.path.join(DATA_HOME, 'imikolov',
+                                              'simple-examples.tgz')
+        if os.path.exists(data_file):
+            self._load_tar(data_file, mode, min_word_freq)
+        else:
+            rng = np.random.RandomState(2 if mode == 'train' else 3)
+            vocab = 300
+            self.word_idx = {f'w{i}': i for i in range(vocab)}
+            self.word_idx['<s>'] = 0
+            self.word_idx['<e>'] = 1
+            n = 2048 if mode == 'train' else 256
+            if data_type == 'SEQ':
+                self.samples = []
+                for _ in range(n // 8):
+                    ln = rng.randint(3, 20)
+                    ids = rng.randint(3, vocab, ln)
+                    self.samples.append(
+                        (np.concatenate([[0], ids]).astype('int64'),
+                         np.concatenate([ids, [1]]).astype('int64')))
+            else:
+                stream = rng.randint(0, vocab, n + window_size)
+                self.samples = [tuple(stream[i:i + window_size].tolist())
+                                for i in range(n)]
+
+    def _load_tar(self, path, mode, min_word_freq):
+        import collections
+        base = './simple-examples/data/ptb.{}.txt'
+        freq = collections.Counter()
+        with tarfile.open(path) as tf:
+            for part in ('train', 'valid'):
+                for line in tf.extractfile(base.format(part)):
+                    words = line.decode().strip().split()
+                    freq.update(words)
+                    freq.update(('<s>', '<e>'))
+            freq.pop('<unk>', None)
+            kept = sorted(((w, c) for w, c in freq.items()
+                           if c > min_word_freq), key=lambda x: (-x[1], x[0]))
+            self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+            self.word_idx['<unk>'] = len(kept)
+            unk = self.word_idx['<unk>']
+            fname = base.format('valid' if mode in ('valid', 'test') else mode)
+            self.samples = []
+            for line in tf.extractfile(fname):
+                toks = line.decode().strip().split()
+                if self.data_type == 'NGRAM':
+                    seq = ['<s>'] + toks + ['<e>']
+                    if len(seq) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in seq]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.samples.append(tuple(ids[i - self.window_size:i]))
+                else:   # SEQ
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    src = [self.word_idx['<s>']] + ids
+                    trg = ids + [self.word_idx['<e>']]
+                    self.samples.append((np.asarray(src, 'int64'),
+                                         np.asarray(trg, 'int64')))
 
     def __getitem__(self, idx):
         s = self.samples[idx]
+        if self.data_type == 'SEQ' and isinstance(s[0], np.ndarray):
+            return s
         return tuple(np.asarray(x, 'int64') for x in s)
 
     def __len__(self):
@@ -89,19 +148,79 @@ class Imikolov(Dataset):
 
 
 class Movielens(Dataset):
+    """MovieLens-1M rating prediction.
+
+    Real file: ml-1m.zip with '::'-separated movies/users/ratings .dat files
+    (reference: python/paddle/text/datasets/movielens.py). Items follow the
+    reference layout: (uid, gender, age_idx, job, mov_id, category_ids,
+    title_word_ids, rating) with rating rescaled to [-3, 5] via r*2-5.
+    """
+
     def __init__(self, data_file=None, mode='train', test_ratio=0.1,
                  rand_seed=0, download=True):
-        rng = np.random.RandomState(rand_seed)
-        n = 1024 if mode == 'train' else 128
-        self.rows = [(rng.randint(1, 943), rng.randint(0, 2), rng.randint(1, 50),
-                      rng.randint(1, 1682), rng.randint(0, 19),
-                      float(rng.randint(1, 6))) for _ in range(n)]
+        data_file = data_file or os.path.join(DATA_HOME, 'movielens',
+                                              'ml-1m.zip')
+        if os.path.exists(data_file):
+            self._load_zip(data_file, mode, test_ratio, rand_seed)
+        else:
+            rng = np.random.RandomState(rand_seed)
+            n = 1024 if mode == 'train' else 128
+            self.rows = [([rng.randint(1, 943)], [rng.randint(0, 2)],
+                          [rng.randint(1, 8)], [rng.randint(0, 21)],
+                          [rng.randint(1, 1682)],
+                          rng.randint(0, 19, rng.randint(1, 4)).tolist(),
+                          rng.randint(0, 100, rng.randint(1, 6)).tolist(),
+                          [float(rng.randint(1, 6)) * 2 - 5.0])
+                         for _ in range(n)]
+
+    def _load_zip(self, path, mode, test_ratio, rand_seed):
+        import re
+        import zipfile
+        title_pat = re.compile(r'(.*)\s*\((\d+)\)\s*$')
+        movies, users = {}, {}
+        cat_dict, title_dict = {}, {}
+        with zipfile.ZipFile(path) as z:
+            with z.open('ml-1m/movies.dat') as f:
+                for line in f:
+                    mid, title, cats = \
+                        line.decode('latin1').strip().split('::')
+                    m = title_pat.match(title)
+                    title = m.group(1) if m else title
+                    for c in cats.split('|'):
+                        cat_dict.setdefault(c, len(cat_dict))
+                    for w in title.lower().split():
+                        title_dict.setdefault(w, len(title_dict))
+                    movies[int(mid)] = (int(mid), cats.split('|'),
+                                        title.lower().split())
+            age_idx = {}
+            with z.open('ml-1m/users.dat') as f:
+                for line in f:
+                    uid, gender, age, job, _ = \
+                        line.decode('latin1').strip().split('::')
+                    age_idx.setdefault(int(age), len(age_idx))
+                    users[int(uid)] = (int(uid), 0 if gender == 'M' else 1,
+                                       age_idx[int(age)], int(job))
+            rng = np.random.RandomState(rand_seed)
+            is_test = mode == 'test'
+            self.rows = []
+            with z.open('ml-1m/ratings.dat') as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, r, _ = \
+                        line.decode('latin1').strip().split('::')
+                    u = users[int(uid)]
+                    m = movies[int(mid)]
+                    self.rows.append(
+                        ([u[0]], [u[1]], [u[2]], [u[3]], [m[0]],
+                         [cat_dict[c] for c in m[1]],
+                         [title_dict[w] for w in m[2]],
+                         [float(r) * 2 - 5.0]))
 
     def __getitem__(self, idx):
-        u, g, a, m, c, r = self.rows[idx]
-        return (np.asarray(u, 'int64'), np.asarray(g, 'int64'),
-                np.asarray(a, 'int64'), np.asarray(m, 'int64'),
-                np.asarray(c, 'int64'), np.asarray(r, 'float32'))
+        row = self.rows[idx]
+        return tuple(np.asarray(x, 'float32' if i == 7 else 'int64')
+                     for i, x in enumerate(row))
 
     def __len__(self):
         return len(self.rows)
@@ -156,33 +275,227 @@ class _SyntheticTranslation(Dataset):
         return len(self.pairs)
 
 
+def _load_wmt_tar(path, mode, src_dict_name, trg_dict_name, data_name,
+                  dict_size, max_len=80):
+    """Shared WMT tar parsing: *.dict members (one word per line, index =
+    line number) + tab-separated parallel corpus members. Reference:
+    python/paddle/text/datasets/wmt14.py _load_data."""
+    import re
+    UNK, START, END = 2, '<s>', '<e>'
+    pairs = []
+    with tarfile.open(path) as tf:
+        names = [m.name for m in tf.getmembers()]
+
+        def find(suffix):
+            # accept both 'src.dict' and size-suffixed 'en_30000.dict' layouts
+            stem = suffix[:-len('.dict')] if suffix.endswith('.dict') else None
+            pat = re.compile(r'(^|/)' + re.escape(stem) + r'(_\d+)?\.dict$') \
+                if stem else None
+            for n in names:
+                if n.endswith(suffix) or (pat and pat.search(n)):
+                    return n
+            return None
+
+        def to_dict(name):
+            d = {}
+            for i, line in enumerate(tf.extractfile(name)):
+                if dict_size > 0 and i >= dict_size:
+                    break
+                d[line.decode('utf-8', 'replace').strip()] = i
+            return d
+
+        src_name, trg_name, data_member = (find(src_dict_name),
+                                           find(trg_dict_name),
+                                           find(data_name))
+        if src_name is None or trg_name is None or data_member is None:
+            return None     # unexpected layout -> caller falls back
+        src_dict = to_dict(src_name)
+        trg_dict = to_dict(trg_name)
+        for line in tf.extractfile(data_member):
+            parts = line.decode('utf-8', 'replace').strip().split('\t')
+            if len(parts) != 2:
+                continue
+            src = [src_dict.get(w, UNK)
+                   for w in [START] + parts[0].split() + [END]]
+            trg_raw = [trg_dict.get(w, UNK) for w in parts[1].split()]
+            if len(src) > max_len or len(trg_raw) > max_len:
+                continue
+            trg_in = [trg_dict[START]] + trg_raw
+            trg_out = trg_raw + [trg_dict[END]]
+            pairs.append((src, trg_in, trg_out))
+    return pairs, src_dict, trg_dict
+
+
 class WMT14(_SyntheticTranslation):
+    """WMT'14 en-fr. Real file: the reference's wmt14.tgz ({mode}/{mode}
+    tab-separated corpus + src.dict/trg.dict members)."""
+
     def __init__(self, data_file=None, mode='train', dict_size=30000,
                  download=True):
-        super().__init__(mode, seed=6)
+        data_file = data_file or os.path.join(DATA_HOME, 'wmt14', 'wmt14.tgz')
+        loaded = None
+        if os.path.exists(data_file):
+            loaded = _load_wmt_tar(data_file, mode, 'src.dict', 'trg.dict',
+                                   '{}/{}'.format(mode, mode), dict_size)
+        if loaded:
+            self.pairs, self.src_dict, self.trg_dict = loaded
+        else:
+            super().__init__(mode, seed=6)
+            return
+        self.src_word_idx = self.src_dict
+        self.trg_word_idx = self.trg_dict
+
+    def __getitem__(self, idx):
+        p = self.pairs[idx]
+        if isinstance(p[0], list):
+            return tuple(np.asarray(x, 'int64') for x in p)
+        return super().__getitem__(idx)
 
 
 class WMT16(_SyntheticTranslation):
+    """WMT'16 en-de (BPE). Real file: the reference's wmt16.tar.gz
+    (wmt16/{mode} corpus + wmt16/{lang}_{size}.dict vocab members)."""
+
     def __init__(self, data_file=None, mode='train', src_dict_size=30000,
                  trg_dict_size=30000, lang='en', download=True):
-        super().__init__(mode, seed=7)
+        data_file = data_file or os.path.join(DATA_HOME, 'wmt16',
+                                              'wmt16.tar.gz')
+        other = 'de' if lang == 'en' else 'en'
+        loaded = None
+        if os.path.exists(data_file):
+            loaded = _load_wmt_tar(
+                data_file, mode, f'{lang}.dict', f'{other}.dict',
+                'wmt16/{}'.format(mode), max(src_dict_size, trg_dict_size))
+        if loaded:
+            self.pairs, self.src_dict, self.trg_dict = loaded
+        else:
+            super().__init__(mode, seed=7)
+            return
+        self.src_word_idx = self.src_dict
+        self.trg_word_idx = self.trg_dict
+
+    def __getitem__(self, idx):
+        p = self.pairs[idx]
+        if isinstance(p[0], list):
+            return tuple(np.asarray(x, 'int64') for x in p)
+        return super().__getitem__(idx)
 
 
 class Conll05st(Dataset):
-    """SRL dataset: (pred, mark, word seq, label seq)."""
+    """CoNLL-2005 SRL (test.wsj split, as in the reference).
+
+    Real files: conll05st-tests.tar.gz with
+    conll05st-release/test.wsj/{words,props}/test.wsj.{words,props}.gz plus
+    the word/verb/target dict files (reference:
+    python/paddle/text/datasets/conll05.py). The props column bracket tags
+    ('(A0*', '*', '*)') expand to B-/I-/O sequences; one sample per
+    (sentence, predicate) pair.
+    """
 
     def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
                  target_dict_file=None, emb_file=None, mode='train',
                  download=True):
-        rng = np.random.RandomState(8)
-        n = 256
+        data_file = data_file or os.path.join(
+            DATA_HOME, 'conll05st', 'conll05st-tests.tar.gz')
+        if os.path.exists(data_file):
+            self._load_real(data_file, word_dict_file, verb_dict_file,
+                            target_dict_file)
+        else:
+            rng = np.random.RandomState(8)
+            self.samples = []
+            for _ in range(256):
+                ln = rng.randint(5, 30)
+                words = rng.randint(0, 300, ln).astype('int64')
+                pred = rng.randint(0, 50, ln).astype('int64')
+                labels = rng.randint(0, 20, ln).astype('int64')
+                self.samples.append((words, pred, labels))
+
+    @staticmethod
+    def _expand_props(col):
+        """Bracket tags -> B-/I-/O label sequence for one predicate column."""
+        out, cur, inside = [], 'O', False
+        for tag in col:
+            if tag == '*':
+                out.append('I-' + cur if inside else 'O')
+            elif tag == '*)':
+                out.append('I-' + cur)
+                inside = False
+            elif '(' in tag:
+                cur = tag[1:tag.find('*')]
+                out.append('B-' + cur)
+                inside = ')' not in tag
+            else:
+                out.append('O')
+        return out
+
+    def _load_real(self, data_file, word_dict_file, verb_dict_file,
+                   target_dict_file):
+        import gzip as _gz
+        base = os.path.dirname(data_file)
+        word_dict_file = word_dict_file or os.path.join(base, 'wordDict.txt')
+        verb_dict_file = verb_dict_file or os.path.join(base, 'verbDict.txt')
+        target_dict_file = target_dict_file or os.path.join(base,
+                                                            'targetDict.txt')
+
+        def load_dict(p):
+            if not os.path.exists(p):
+                return None
+            with open(p) as f:
+                return {line.strip(): i for i, line in enumerate(f)}
+
+        self.word_dict = load_dict(word_dict_file) or {}
+        self.verb_dict = load_dict(verb_dict_file) or {}
+        self.label_dict = {}
+        if os.path.exists(target_dict_file):
+            tags = set()
+            with open(target_dict_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line.startswith(('B-', 'I-')):
+                        tags.add(line[2:])
+            for t in sorted(tags):
+                self.label_dict['B-' + t] = len(self.label_dict)
+                self.label_dict['I-' + t] = len(self.label_dict)
+            self.label_dict['O'] = len(self.label_dict)
+
         self.samples = []
-        for _ in range(n):
-            ln = rng.randint(5, 30)
-            words = rng.randint(0, 300, ln).astype('int64')
-            pred = rng.randint(0, 50, ln).astype('int64')
-            labels = rng.randint(0, 20, ln).astype('int64')
-            self.samples.append((words, pred, labels))
+        pre = 'conll05st-release/test.wsj'
+        with tarfile.open(data_file) as tf:
+            wf = _gz.GzipFile(
+                fileobj=tf.extractfile(f'{pre}/words/test.wsj.words.gz'))
+            pf = _gz.GzipFile(
+                fileobj=tf.extractfile(f'{pre}/props/test.wsj.props.gz'))
+            sent, cols = [], []
+            for wline, pline in zip(wf, pf):
+                word = wline.decode().strip()
+                props = pline.decode().strip().split()
+                if not props:                       # sentence boundary
+                    self._emit(sent, cols)
+                    sent, cols = [], []
+                else:
+                    sent.append(word)
+                    cols.append(props)
+            self._emit(sent, cols)
+
+    def _emit(self, sent, cols):
+        if not cols:
+            return
+        n_pred = len(cols[0]) - 1
+        verbs = [row[0] for row in cols if row[0] != '-']
+        for j in range(n_pred):
+            labels = self._expand_props([row[j + 1] for row in cols])
+            if 'B-V' not in labels:
+                continue
+            # unknown -> in-vocabulary UNK (id 0), as in the reference loader;
+            # unknown label tags -> 'O' (always last in label_dict)
+            words = np.asarray(
+                [self.word_dict.get(w.lower(), 0) for w in sent], 'int64')
+            verb = verbs[j] if j < len(verbs) else '-'
+            pred = np.full(len(sent), self.verb_dict.get(verb, 0), 'int64')
+            o_id = self.label_dict.get('O', 0)
+            lab = np.asarray([self.label_dict.get(t, o_id) for t in labels],
+                             'int64')
+            self.samples.append((words, pred, lab))
 
     def __getitem__(self, idx):
         return self.samples[idx]

@@ -111,39 +111,113 @@ class Cifar100(Cifar10):
 
 
 class Flowers(Dataset):
+    """Oxford 102 Flowers. Real files: 102flowers.tgz (jpg/image_%05d.jpg) +
+    imagelabels.mat + setid.mat (reference:
+    python/paddle/vision/datasets/flowers.py)."""
+
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode='train', transform=None, download=True, backend=None):
         self.transform = transform
-        n = 256 if mode == 'train' else 64
-        self.images, self.labels = _synthetic_images(n, (64, 64, 3), 102, 2)
+        base = os.path.join(DATA_HOME, 'flowers')
+        data_file = data_file or os.path.join(base, '102flowers.tgz')
+        label_file = label_file or os.path.join(base, 'imagelabels.mat')
+        setid_file = setid_file or os.path.join(base, 'setid.mat')
+        if (os.path.exists(data_file) and os.path.exists(label_file)
+                and os.path.exists(setid_file)):
+            import scipy.io as sio
+            split_key = {'train': 'trnid', 'valid': 'valid',
+                         'test': 'tstid'}[mode]
+            self.indexes = sio.loadmat(setid_file)[split_key][0].tolist()
+            self.flower_labels = sio.loadmat(label_file)['labels'][0]
+            # extract once (reference behaviour): random access through a
+            # gzip tar would re-decompress from the start on every backward
+            # seek, making a shuffled epoch O(archive) per item
+            self._data_path = data_file[:-4] if data_file.endswith('.tgz') \
+                else data_file + '.d'
+            if not os.path.isdir(os.path.join(self._data_path, 'jpg')):
+                os.makedirs(self._data_path, exist_ok=True)
+                with tarfile.open(data_file) as tf:
+                    tf.extractall(self._data_path)
+            self.images = None
+        else:
+            n = 256 if mode == 'train' else 64
+            self.images, self.labels = _synthetic_images(n, (64, 64, 3), 102, 2)
+
+    def _read_jpg(self, index):
+        from PIL import Image
+        p = os.path.join(self._data_path, 'jpg', 'image_%05d.jpg' % index)
+        return np.asarray(Image.open(p).convert('RGB'))
 
     def __getitem__(self, idx):
-        img = self.images[idx].astype('float32')
+        if self.images is not None:
+            img = self.images[idx].astype('float32')
+            label = np.asarray([self.labels[idx]], 'int64')
+        else:
+            index = self.indexes[idx]
+            img = self._read_jpg(index).astype('float32')
+            label = np.asarray([self.flower_labels[index - 1]], 'int64')
         if self.transform is not None:
             img = self.transform(img)
-        return img, np.asarray([self.labels[idx]], 'int64')
+        return img, label
 
     def __len__(self):
-        return len(self.images)
+        return len(self.images) if self.images is not None \
+            else len(self.indexes)
 
 
 class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation. Real file: VOCtrainval tar with
+    ImageSets/Segmentation/{train,val,trainval}.txt listing ids, JPEGImages
+    + SegmentationClass (reference: python/paddle/vision/datasets/voc2012.py)."""
+
+    _PRE = 'VOCdevkit/VOC2012'
+
     def __init__(self, data_file=None, mode='train', transform=None,
                  download=True, backend=None):
         self.transform = transform
-        n = 64
-        rng = np.random.RandomState(3)
-        self.images = (rng.rand(n, 3, 64, 64) * 255).astype('uint8')
-        self.masks = rng.randint(0, 21, (n, 64, 64)).astype('int64')
+        data_file = data_file or os.path.join(DATA_HOME, 'voc2012',
+                                              'VOCtrainval_11-May-2012.tar')
+        self._tar = None
+        if os.path.exists(data_file):
+            self._data_file = data_file
+            name = {'train': 'train', 'valid': 'val', 'test': 'val',
+                    'trainval': 'trainval'}[mode]
+            with tarfile.open(data_file) as tf:
+                lst = tf.extractfile(
+                    f'{self._PRE}/ImageSets/Segmentation/{name}.txt')
+                self.ids = [l.decode().strip() for l in lst if l.strip()]
+            self.images = None
+        else:
+            n = 64
+            rng = np.random.RandomState(3)
+            self.images = (rng.rand(n, 3, 64, 64) * 255).astype('uint8')
+            self.masks = rng.randint(0, 21, (n, 64, 64)).astype('int64')
+
+    def _read(self, member):
+        import io as _io
+        from PIL import Image
+        if self._tar is None:
+            self._tar = tarfile.open(self._data_file)
+        f = self._tar.extractfile(member)
+        return Image.open(_io.BytesIO(f.read()))
 
     def __getitem__(self, idx):
-        img = self.images[idx].astype('float32')
+        if self.images is not None:
+            img = self.images[idx].astype('float32')
+            mask = self.masks[idx]
+        else:
+            iid = self.ids[idx]
+            img = np.asarray(self._read(
+                f'{self._PRE}/JPEGImages/{iid}.jpg').convert('RGB'),
+                'float32')
+            mask = np.asarray(self._read(
+                f'{self._PRE}/SegmentationClass/{iid}.png'), 'int64')
         if self.transform is not None:
             img = self.transform(img)
-        return img, self.masks[idx]
+        return img, mask
 
     def __len__(self):
-        return len(self.images)
+        return len(self.images) if self.images is not None else len(self.ids)
 
 
 IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.npy')

@@ -1,0 +1,216 @@
+"""Real-file dataset loaders: fabricate each reference file format in tmp
+dirs and check the parsers (SURVEY items 41/43)."""
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+
+def test_mnist_idx_files(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    imgs = (np.arange(3 * 28 * 28) % 255).astype('uint8').reshape(3, 28, 28)
+    labels = np.asarray([1, 2, 3], 'uint8')
+    ip = tmp_path / 'imgs.gz'
+    lp = tmp_path / 'labels.gz'
+    with gzip.open(ip, 'wb') as f:
+        f.write(struct.pack('>IIII', 2051, 3, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, 'wb') as f:
+        f.write(struct.pack('>II', 2049, 3) + labels.tobytes())
+    ds = MNIST(image_path=str(ip), label_path=str(lp), mode='train')
+    assert len(ds) == 3
+    img, lab = ds[2]
+    assert img.shape == (28, 28, 1) and int(lab[0]) == 3
+    assert np.allclose(img[..., 0], imgs[2])
+
+
+def test_cifar10_tar(tmp_path):
+    from paddle_tpu.vision.datasets import Cifar10
+    data = (np.random.RandomState(0).rand(4, 3072) * 255).astype('uint8')
+    batch = {b'data': data, b'labels': [0, 1, 2, 3]}
+    p = tmp_path / 'cifar-10-python.tar.gz'
+    with tarfile.open(p, 'w:gz') as tf:
+        raw = pickle.dumps(batch)
+        info = tarfile.TarInfo('cifar-10-batches-py/data_batch_1')
+        info.size = len(raw)
+        tf.addfile(info, io.BytesIO(raw))
+    ds = Cifar10(data_file=str(p), mode='train')
+    assert len(ds) == 4
+    img, lab = ds[1]
+    assert img.shape == (32, 32, 3) and int(lab) == 1
+
+
+def test_imikolov_tar(tmp_path):
+    from paddle_tpu.text.datasets import Imikolov
+    text = b'the cat sat\nthe dog sat on the mat\n'
+    p = tmp_path / 'simple-examples.tgz'
+    with tarfile.open(p, 'w:gz') as tf:
+        for part in ('train', 'valid'):
+            info = tarfile.TarInfo(f'./simple-examples/data/ptb.{part}.txt')
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    ds = Imikolov(data_file=str(p), mode='train', window_size=3,
+                  min_word_freq=0)
+    assert len(ds) > 0
+    item = ds[0]
+    assert len(item) == 3 and all(x.dtype == np.int64 for x in item)
+    # 'the' appears most -> index 0 after (<s>, <e>, the) freq sort ties
+    assert '<unk>' in ds.word_idx
+    seq = Imikolov(data_file=str(p), mode='train', data_type='SEQ',
+                   min_word_freq=0)
+    src, trg = seq[0]
+    assert src[0] == seq.word_idx['<s>'] and trg[-1] == seq.word_idx['<e>']
+
+
+def test_movielens_zip(tmp_path):
+    from paddle_tpu.text.datasets import Movielens
+    p = tmp_path / 'ml-1m.zip'
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Jumanji (1995)::Adventure\n")
+    users = ("1::M::25::10::48067\n"
+             "2::F::35::5::55117\n")
+    ratings = "".join(f"{u}::{m}::{r}::978300760\n"
+                      for u, m, r in [(1, 1, 5), (1, 2, 3), (2, 1, 4),
+                                      (2, 2, 1)] * 16)
+    with zipfile.ZipFile(p, 'w') as z:
+        z.writestr('ml-1m/movies.dat', movies)
+        z.writestr('ml-1m/users.dat', users)
+        z.writestr('ml-1m/ratings.dat', ratings)
+    tr = Movielens(data_file=str(p), mode='train', test_ratio=0.25)
+    te = Movielens(data_file=str(p), mode='test', test_ratio=0.25)
+    assert len(tr) + len(te) == 64
+    item = tr[0]
+    assert len(item) == 8
+    assert item[7].dtype == np.float32          # rescaled rating
+    assert -3.0 <= float(item[7][0]) <= 5.0
+
+
+def test_wmt14_tar(tmp_path):
+    from paddle_tpu.text.datasets import WMT14
+    p = tmp_path / 'wmt14.tgz'
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    corpus = "hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, 'w:gz') as tf:
+        for name, content in [('data/src.dict', src_dict),
+                              ('data/trg.dict', trg_dict),
+                              ('train/train', corpus)]:
+            raw = content.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    ds = WMT14(data_file=str(p), mode='train', dict_size=5)
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert src[0] == 0 and src[-1] == 1          # <s> ... <e>
+    assert trg_in[0] == 0 and trg_out[-1] == 1
+    assert src.tolist() == [0, 3, 4, 1]
+    assert trg_out.tolist() == [3, 4, 1]
+
+
+def test_conll05_tar(tmp_path):
+    from paddle_tpu.text.datasets import Conll05st
+    base = tmp_path
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = "-  (A0*  *\n-  *)  *\nsit  (V*)  *\n\n-  (V*)\nbark  *\n\n"
+    # columns: each line 'verb  col1 col2...' split by whitespace
+    words_lines = "The\ncat\nsat\n\n"
+    props_lines = "-\t(A0*\n-\t*)\nsat\t(V*)\n\n"
+    p = base / 'conll05st-tests.tar.gz'
+    with tarfile.open(p, 'w:gz') as tf:
+        for name, content in [
+                ('conll05st-release/test.wsj/words/test.wsj.words.gz',
+                 words_lines),
+                ('conll05st-release/test.wsj/props/test.wsj.props.gz',
+                 props_lines)]:
+            raw = gzip.compress(content.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    (base / 'wordDict.txt').write_text('the\ncat\nsat\n')
+    (base / 'verbDict.txt').write_text('sat\n')
+    (base / 'targetDict.txt').write_text('B-A0\nI-A0\nB-V\nO\n')
+    ds = Conll05st(data_file=str(p))
+    assert len(ds) == 1
+    w, pred, lab = ds[0]
+    assert len(w) == 3 and len(lab) == 3
+    assert lab.tolist()[0] == ds.label_dict['B-A0']
+    assert lab.tolist()[2] == ds.label_dict['B-V']
+
+
+def test_flowers_real_files(tmp_path):
+    PIL = pytest.importorskip('PIL')
+    from PIL import Image
+    import scipy.io as sio
+    from paddle_tpu.vision.datasets import Flowers
+    tgz = tmp_path / '102flowers.tgz'
+    with tarfile.open(tgz, 'w:gz') as tf:
+        for i in (1, 2, 3):
+            buf = io.BytesIO()
+            Image.fromarray((np.full((8, 8, 3), i * 40)).astype('uint8')) \
+                .save(buf, format='JPEG')
+            raw = buf.getvalue()
+            info = tarfile.TarInfo('jpg/image_%05d.jpg' % i)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    sio.savemat(tmp_path / 'imagelabels.mat',
+                {'labels': np.asarray([[5, 6, 7]])})
+    sio.savemat(tmp_path / 'setid.mat',
+                {'trnid': np.asarray([[1, 2]]), 'valid': np.asarray([[3]]),
+                 'tstid': np.asarray([[3]])})
+    ds = Flowers(data_file=str(tgz), label_file=str(tmp_path / 'imagelabels.mat'),
+                 setid_file=str(tmp_path / 'setid.mat'), mode='train')
+    assert len(ds) == 2
+    img, lab = ds[0]
+    assert img.shape == (8, 8, 3) and int(lab[0]) == 5
+
+
+def test_voc2012_tar(tmp_path):
+    PIL = pytest.importorskip('PIL')
+    from PIL import Image
+    from paddle_tpu.vision.datasets import VOC2012
+    p = tmp_path / 'VOCtrainval_11-May-2012.tar'
+    pre = 'VOCdevkit/VOC2012'
+    with tarfile.open(p, 'w') as tf:
+        ids = "img1\nimg2\n"
+        info = tarfile.TarInfo(f'{pre}/ImageSets/Segmentation/train.txt')
+        info.size = len(ids)
+        tf.addfile(info, io.BytesIO(ids.encode()))
+        for iid in ('img1', 'img2'):
+            buf = io.BytesIO()
+            Image.fromarray(np.zeros((6, 6, 3), 'uint8')).save(buf, 'JPEG')
+            raw = buf.getvalue()
+            info = tarfile.TarInfo(f'{pre}/JPEGImages/{iid}.jpg')
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+            buf = io.BytesIO()
+            Image.fromarray(np.full((6, 6), 7, 'uint8'), mode='L') \
+                .save(buf, 'PNG')
+            raw = buf.getvalue()
+            info = tarfile.TarInfo(f'{pre}/SegmentationClass/{iid}.png')
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    ds = VOC2012(data_file=str(p), mode='train')
+    assert len(ds) == 2
+    img, mask = ds[0]
+    assert img.shape == (6, 6, 3) and mask.shape == (6, 6)
+    assert int(mask[0, 0]) == 7
+
+
+def test_imdb_tar(tmp_path):
+    from paddle_tpu.text.datasets import Imdb
+    p = tmp_path / 'aclImdb_v1.tar.gz'
+    with tarfile.open(p, 'w:gz') as tf:
+        for name, text in [('aclImdb/train/pos/0_9.txt', b'great movie fun'),
+                           ('aclImdb/train/neg/1_2.txt', b'terrible bad')]:
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    ds = Imdb(data_file=str(p), mode='train', cutoff=10)
+    assert len(ds) == 2
+    assert sorted(int(ds[i][1]) for i in range(2)) == [0, 1]
