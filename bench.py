@@ -1,78 +1,213 @@
 """Benchmark: GPT-350M-class causal-LM training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "mfu", "predictor_p50_ms", ...}
+
+Hardened against a flaky/hung TPU backend (the round-1 failure mode):
+ - backend init is probed in a SUBPROCESS with a hard 90 s timeout;
+ - each measurement config runs in its own bounded subprocess;
+ - the parent process never touches a jax backend, always emits its JSON
+   line, and exits 0/1 — never hangs into the driver's kill timeout.
 
 vs_baseline normalizes against REFERENCE_TOKENS_PER_SEC — the throughput the
 reference stack (PaddlePaddle fluid GPT, fp16, single A100-class device)
 achieves on the same model config per public Megatron/Paddle GPT benchmarks
 (~55k tok/s for 350M). BASELINE.json carries no published numbers, so this
-constant anchors cross-round comparisons.
+constant anchors cross-round comparisons. mfu = achieved model FLOPs
+(6 * n_params * tokens/s) / peak chip FLOPs for the detected TPU generation.
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 REFERENCE_TOKENS_PER_SEC = 55000.0
+PROBE_TIMEOUT_S = 90
+CONFIG_TIMEOUT_S = 900
+PREDICTOR_TIMEOUT_S = 420
+
+# Peak bf16 matmul FLOP/s per chip by TPU generation.
+PEAK_FLOPS = {
+    'v4': 275e12,
+    'v5e': 197e12,
+    'v5p': 459e12,
+    'v6e': 918e12,
+    'cpu': 1e12,  # nominal; mfu on cpu is not meaningful
+}
 
 
-def build(batch, seq, hidden, layers, heads, vocab):
+def _peak_flops(platform):
+    gen = os.environ.get('PALLAS_AXON_TPU_GEN', '').lower()
+    if platform == 'cpu':
+        return PEAK_FLOPS['cpu']
+    return PEAK_FLOPS.get(gen, PEAK_FLOPS['v5e'])
+
+
+# --------------------------------------------------------------------------
+# child-process entry points
+# --------------------------------------------------------------------------
+
+def _child_probe():
+    import jax
+    devs = jax.devices()
+    print(json.dumps({'platform': devs[0].platform, 'n': len(devs)}))
+
+
+def _child_train(cfg):
+    import jax
+    import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.models import gpt
-    cfg = gpt.GPTConfig(vocab_size=vocab, hidden_size=hidden,
-                        num_layers=layers, num_heads=heads, max_seq_len=seq,
-                        dtype='bfloat16', remat=True, use_flash=True)
-    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+    batch, seq = cfg['batch'], cfg['seq']
+    gcfg = gpt.GPTConfig(vocab_size=cfg['vocab'], hidden_size=cfg['hidden'],
+                         num_layers=cfg['layers'], num_heads=cfg['heads'],
+                         max_seq_len=seq, dtype='bfloat16', remat=True,
+                         use_flash=True)
+    params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
     opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
     opt_state = opt.functional_init(params)
-    step = gpt.make_train_step(cfg, opt)
-    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, vocab)
-    return step, params, opt_state, toks
-
-
-def run(batch=8, seq=1024, hidden=1024, layers=24, heads=16, vocab=32768,
-        iters=20):
-    step, params, opt_state, toks = build(batch, seq, hidden, layers, heads,
-                                          vocab)
+    step = gpt.make_train_step(gcfg, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                              cfg['vocab'])
     key = jax.random.PRNGKey(2)
     lr = jnp.asarray(2e-4)
-    # warmup / compile
     loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
     loss.block_until_ready()
+    iters = cfg.get('iters', 20)
     t0 = time.perf_counter()
-    for i in range(iters):
+    for _ in range(iters):
         loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq * iters / dt
-    return tokens_per_sec, float(loss)
+    print(json.dumps({
+        'tokens_per_sec': batch * seq * iters / dt,
+        'loss': float(loss),
+        'n_params': n_params,
+        'platform': jax.devices()[0].platform,
+    }))
+
+
+def _child_predictor():
+    """p50 latency of a served vision model (ResNet-18, batch 1) through the
+    full jit.save -> Predictor serving path, mirroring Paddle-Inference."""
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.vision import models as vmodels
+
+    net = vmodels.resnet18()
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, 'resnet18')
+    spec = [paddle.static.InputSpec(shape=[1, 3, 224, 224], dtype='float32')]
+    paddle.jit.save(net, path, input_spec=spec)
+    pred = inference.create_predictor(inference.Config(path + '.pdmodel'))
+    x = np.random.rand(1, 3, 224, 224).astype('float32')
+    # warmup / compile
+    out = pred.run([x])
+    lat = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        out = pred.run([x])
+        _ = np.asarray(out[0])
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    print(json.dumps({'p50_ms': lat[len(lat) // 2] * 1e3}))
+
+
+# --------------------------------------------------------------------------
+# parent orchestration (never touches a jax backend)
+# --------------------------------------------------------------------------
+
+def _run_child(argv, timeout):
+    """Run a child bench stage; returns (parsed_json|None, note)."""
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f'timeout>{timeout}s'
+    if p.returncode != 0:
+        tail = (p.stderr or '').strip().splitlines()[-3:]
+        return None, f'rc={p.returncode}: ' + ' | '.join(tail)
+    for line in reversed((p.stdout or '').strip().splitlines()):
+        try:
+            return json.loads(line), ''
+        except ValueError:
+            continue
+    return None, 'no json in child output'
 
 
 def main():
+    out = {'metric': 'gpt350m_train_tokens_per_sec_per_chip',
+           'value': 0.0, 'unit': 'tokens/s', 'vs_baseline': 0.0}
+
+    probe, note = _run_child(['--child-probe'], PROBE_TIMEOUT_S)
+    if probe is None:  # one retry — the tunnel is known to be flaky
+        print(f'probe attempt 1 failed ({note}); retrying', file=sys.stderr)
+        probe, note = _run_child(['--child-probe'], PROBE_TIMEOUT_S)
+    if probe is None:
+        out['note'] = f'backend probe failed ({note}); no measurement taken'
+        print(json.dumps(out))
+        return 1
+    platform, ndev = probe['platform'], probe['n']
+    out['platform'] = platform
+    print(f'probe ok: platform={platform} n={ndev}', file=sys.stderr)
+
     configs = [
-        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16),
-        dict(batch=4, seq=1024, hidden=1024, layers=24, heads=16),
-        dict(batch=4, seq=512, hidden=768, layers=12, heads=12),
+        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
+             vocab=32768, iters=20),
+        dict(batch=4, seq=1024, hidden=1024, layers=24, heads=16,
+             vocab=32768, iters=20),
+        dict(batch=4, seq=512, hidden=768, layers=12, heads=12,
+             vocab=32768, iters=10),
     ]
+    if platform == 'cpu':  # keep the smoke path fast off-TPU
+        configs = [dict(batch=2, seq=256, hidden=256, layers=4, heads=4,
+                        vocab=8192, iters=5)]
+
+    result = None
     for cfg in configs:
-        try:
-            tps, loss = run(**cfg)
-            print(json.dumps({
-                'metric': 'gpt350m_train_tokens_per_sec_per_chip',
-                'value': round(tps, 1),
-                'unit': 'tokens/s',
-                'vs_baseline': round(tps / REFERENCE_TOKENS_PER_SEC, 3),
-            }))
-            return 0
-        except Exception as e:  # noqa: BLE001 — fall back to smaller config
-            print(f'bench config {cfg} failed: {type(e).__name__}: {e}',
-                  file=sys.stderr)
-    print(json.dumps({'metric': 'gpt350m_train_tokens_per_sec_per_chip',
-                      'value': 0.0, 'unit': 'tokens/s', 'vs_baseline': 0.0}))
-    return 1
+        result, note = _run_child(['--child-train', json.dumps(cfg)],
+                                  CONFIG_TIMEOUT_S)
+        if result is not None:
+            out['config'] = cfg
+            break
+        print(f'bench config {cfg} failed: {note}', file=sys.stderr)
+
+    if result is None:
+        out['note'] = f'all configs failed; last: {note}'
+        print(json.dumps(out))
+        return 1
+
+    tps = result['tokens_per_sec']
+    out['value'] = round(tps, 1)
+    out['vs_baseline'] = round(tps / REFERENCE_TOKENS_PER_SEC, 3)
+    out['loss'] = round(result['loss'], 4)
+    out['n_params'] = result['n_params']
+    out['mfu'] = round(6.0 * result['n_params'] * tps
+                       / _peak_flops(platform), 4)
+
+    pred, pnote = _run_child(['--child-predictor'], PREDICTOR_TIMEOUT_S)
+    if pred is not None:
+        out['predictor_p50_ms'] = round(pred['p50_ms'], 3)
+    else:
+        print(f'predictor bench failed: {pnote}', file=sys.stderr)
+
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    if len(sys.argv) > 1 and sys.argv[1] == '--child-probe':
+        _child_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-train':
+        _child_train(json.loads(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-predictor':
+        _child_predictor()
+    else:
+        sys.exit(main())
