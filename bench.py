@@ -3,11 +3,16 @@
 Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", "mfu", "predictor_p50_ms", ...}
 
-Hardened against a flaky/hung TPU backend (the round-1 failure mode):
- - backend init is probed in a SUBPROCESS with a hard 90 s timeout;
+Hardened against a flaky/hung TPU backend (the round-1/2 failure mode):
+ - backend init is probed in a SUBPROCESS: 3 attempts (300s, 120s, 120s)
+   with a faulthandler stack dump into captured stderr on timeout;
+ - if the axon backend never answers, falls back to a clearly-labeled CPU
+   measurement under a DIFFERENT metric name (never recorded as the TPU
+   headline number);
  - each measurement config runs in its own bounded subprocess;
  - the parent process never touches a jax backend, always emits its JSON
-   line, and exits 0/1 — never hangs into the driver's kill timeout.
+   line, and exits 0/1. Worst-case probe phase ~690s before fallback —
+   budget the driver's kill timeout accordingly.
 
 vs_baseline normalizes against REFERENCE_TOKENS_PER_SEC — the throughput the
 reference stack (PaddlePaddle fluid GPT, fp16, single A100-class device)
@@ -23,9 +28,11 @@ import sys
 import time
 
 REFERENCE_TOKENS_PER_SEC = 55000.0
-PROBE_TIMEOUT_S = 90
+PROBE_TIMEOUT_S = 300          # cold axon init can take minutes
+PROBE_RETRIES = 3
 CONFIG_TIMEOUT_S = 900
 PREDICTOR_TIMEOUT_S = 420
+RELAY_PORT = 2024              # axon loopback relay (AXON_POOL_SVC_OVERRIDE)
 
 # Peak bf16 matmul FLOP/s per chip by TPU generation.
 PEAK_FLOPS = {
@@ -48,14 +55,58 @@ def _peak_flops(platform):
 # child-process entry points
 # --------------------------------------------------------------------------
 
-def _child_probe():
+def _force_cpu_if_requested():
+    """The axon sitecustomize force-sets jax_platforms='axon,cpu' at import,
+    overriding the JAX_PLATFORMS env var — so the CPU fallback must override
+    the config object itself, after import."""
     import jax
+    if os.environ.get('BENCH_FORCE_CPU') == '1':
+        jax.config.update('jax_platforms', 'cpu')
+
+
+def _arm_watchdog(default_timeout):
+    """If the parent kills this child on timeout, leave a stack trace in
+    stderr so the failure is diagnosable from the bench artifact (round-2
+    lesson: an empty stderr tail makes a hang undiagnosable)."""
+    import faulthandler
+    deadline = int(os.environ.get('BENCH_CHILD_TIMEOUT', default_timeout))
+    faulthandler.dump_traceback_later(max(deadline - 15, 5), exit=False)
+
+
+def _child_probe():
+    _arm_watchdog(PROBE_TIMEOUT_S)
+    import jax
+    _force_cpu_if_requested()
     devs = jax.devices()
     print(json.dumps({'platform': devs[0].platform, 'n': len(devs)}))
 
 
+def _relay_tcp_state():
+    """Cheap TCP dial of the axon loopback relay: distinguishes 'tunnel
+    process absent' (refused) from 'tunnel up but far side dead' (EOF)
+    from 'far side alive' (open/silent). Diagnostic only."""
+    import socket
+    try:
+        s = socket.create_connection(('127.0.0.1', RELAY_PORT), timeout=5)
+    except Exception as e:
+        return f'refused ({e.__class__.__name__})'
+    try:
+        s.settimeout(3)
+        try:
+            data = s.recv(1)
+            return 'eof-on-connect' if not data else 'server-spoke'
+        except socket.timeout:
+            return 'open-silent'
+        except OSError as e:
+            return f'reset-on-read ({e.__class__.__name__})'
+    finally:
+        s.close()
+
+
 def _child_train(cfg):
+    _arm_watchdog(CONFIG_TIMEOUT_S)
     import jax
+    _force_cpu_if_requested()
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.models import gpt
@@ -95,6 +146,9 @@ def _child_predictor():
     full jit.save -> Predictor serving path, mirroring Paddle-Inference."""
     import tempfile
 
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import inference
@@ -124,36 +178,83 @@ def _child_predictor():
 # parent orchestration (never touches a jax backend)
 # --------------------------------------------------------------------------
 
-def _run_child(argv, timeout):
-    """Run a child bench stage; returns (parsed_json|None, note)."""
+def _run_child(argv, timeout, env=None):
+    """Run a child bench stage; returns (parsed_json|None, note).
+
+    On failure the note carries the child's full stderr tail (not 3 lines) —
+    rounds 1-2 were undiagnosable because the stack trace was discarded."""
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
     try:
         p = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None, f'timeout>{timeout}s'
+                           capture_output=True, text=True, timeout=timeout,
+                           env=child_env)
+        stderr = p.stderr or ''
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if isinstance(err, bytes):
+            err = err.decode('utf-8', 'replace')
+        tail = (err or '').strip()[-1500:]
+        return None, f'timeout>{timeout}s; child stderr tail: {tail}'
     if p.returncode != 0:
-        tail = (p.stderr or '').strip().splitlines()[-3:]
-        return None, f'rc={p.returncode}: ' + ' | '.join(tail)
+        return None, f'rc={p.returncode}: {stderr.strip()[-1500:]}'
     for line in reversed((p.stdout or '').strip().splitlines()):
         try:
             return json.loads(line), ''
         except ValueError:
             continue
-    return None, 'no json in child output'
+    return None, f'no json in child output; stderr tail: {stderr.strip()[-800:]}'
 
 
 def main():
     out = {'metric': 'gpt350m_train_tokens_per_sec_per_chip',
            'value': 0.0, 'unit': 'tokens/s', 'vs_baseline': 0.0}
 
-    probe, note = _run_child(['--child-probe'], PROBE_TIMEOUT_S)
-    if probe is None:  # one retry — the tunnel is known to be flaky
-        print(f'probe attempt 1 failed ({note}); retrying', file=sys.stderr)
-        probe, note = _run_child(['--child-probe'], PROBE_TIMEOUT_S)
+    out['relay_tcp'] = _relay_tcp_state()
+    print(f'relay tcp state: {out["relay_tcp"]}', file=sys.stderr)
+
+    probe = None
+    timeouts = [PROBE_TIMEOUT_S, 120, 120][:PROBE_RETRIES]
+    for attempt, t in enumerate(timeouts):
+        probe, note = _run_child(['--child-probe'], t,
+                                 env={'BENCH_CHILD_TIMEOUT': str(t)})
+        if probe is not None:
+            break
+        print(f'probe attempt {attempt + 1}/{PROBE_RETRIES} failed ({note})',
+              file=sys.stderr)
+        if attempt + 1 < len(timeouts):
+            time.sleep(10)
     if probe is None:
-        out['note'] = f'backend probe failed ({note}); no measurement taken'
+        # Last resort: measure on CPU so the round records SOME number and
+        # proves the training stack executes end to end. Clearly labeled.
+        out['note'] = (f'axon backend unreachable after {PROBE_RETRIES} '
+                       f'attempts (relay_tcp={out["relay_tcp"]}); last: '
+                       f'{note}; falling back to CPU')
+        cpu_env = {'BENCH_FORCE_CPU': '1', 'BENCH_CHILD_TIMEOUT': '120'}
+        probe, cnote = _run_child(['--child-probe'], 120, env=cpu_env)
+        if probe is None:
+            out['note'] += f'; CPU fallback also failed: {cnote}'
+            print(json.dumps(out))
+            return 1
+        cfg = dict(batch=2, seq=256, hidden=256, layers=4, heads=4,
+                   vocab=8192, iters=5)
+        cpu_env['BENCH_CHILD_TIMEOUT'] = str(CONFIG_TIMEOUT_S)
+        result, cnote = _run_child(['--child-train', json.dumps(cfg)],
+                                   CONFIG_TIMEOUT_S, env=cpu_env)
+        if result is None:
+            out['note'] += f'; CPU train failed: {cnote}'
+            print(json.dumps(out))
+            return 1
+        # A toy model on CPU is NOT the headline TPU metric: rename it so
+        # cross-round tooling never mistakes it for a comparable number.
+        tps = result['tokens_per_sec']
+        out.update(metric='gpt_toy_cpu_fallback_tokens_per_sec',
+                   platform='cpu', config=cfg, value=round(tps, 1),
+                   vs_baseline=0.0,
+                   loss=round(result['loss'], 4), n_params=result['n_params'])
         print(json.dumps(out))
-        return 1
+        return 0
     platform, ndev = probe['platform'], probe['n']
     out['platform'] = platform
     print(f'probe ok: platform={platform} n={ndev}', file=sys.stderr)
@@ -166,7 +267,9 @@ def main():
         dict(batch=4, seq=512, hidden=768, layers=12, heads=12,
              vocab=32768, iters=10),
     ]
-    if platform == 'cpu':  # keep the smoke path fast off-TPU
+    if platform == 'cpu':  # keep the smoke path fast off-TPU, and never
+        # record a toy CPU number under the TPU headline metric name
+        out['metric'] = 'gpt_toy_cpu_fallback_tokens_per_sec'
         configs = [dict(batch=2, seq=256, hidden=256, layers=4, heads=4,
                         vocab=8192, iters=5)]
 
@@ -186,7 +289,8 @@ def main():
 
     tps = result['tokens_per_sec']
     out['value'] = round(tps, 1)
-    out['vs_baseline'] = round(tps / REFERENCE_TOKENS_PER_SEC, 3)
+    out['vs_baseline'] = (round(tps / REFERENCE_TOKENS_PER_SEC, 3)
+                          if platform != 'cpu' else 0.0)
     out['loss'] = round(result['loss'], 4)
     out['n_params'] = result['n_params']
     out['mfu'] = round(6.0 * result['n_params'] * tps
@@ -203,7 +307,9 @@ def main():
 
 
 if __name__ == '__main__':
-    if len(sys.argv) > 1 and sys.argv[1] == '--child-probe':
+    if len(sys.argv) > 1 and sys.argv[1] == '--relay-state':
+        print(_relay_tcp_state())
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-probe':
         _child_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-train':
         _child_train(json.loads(sys.argv[2]))

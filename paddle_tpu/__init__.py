@@ -8,10 +8,14 @@ __version__ = '0.1.0'
 
 import jax as _jax
 
-# Paddle's default integer dtype is int64; JAX needs x64 enabled for that.
-# Float defaults remain float32 everywhere (creation ops force it), so TPU
-# perf is unaffected; bf16 comes from amp / model dtype configs.
-_jax.config.update('jax_enable_x64', True)
+# jax_enable_x64 is deliberately OFF: 64-bit scalars/indices break Mosaic
+# (pallas) lowering on TPU and double index HBM traffic. Paddle's int64
+# default is emulated at the API boundary instead — core/dtype.convert_dtype
+# canonicalizes int64/float64 requests to int32/float32, matching XLA's own
+# canonicalization, so user programs written against Paddle semantics run
+# unchanged. Forced off (not just left unset) so an ambient JAX_ENABLE_X64=1
+# can't silently mix 64-bit tracing back in.
+_jax.config.update('jax_enable_x64', False)
 
 from .core.dtype import (  # noqa: F401
     bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,

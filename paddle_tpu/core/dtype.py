@@ -25,14 +25,33 @@ _STR2DTYPE = {
     'complex64': complex64, 'complex128': complex128,
 }
 
+# TPU-native canonicalization: jax_enable_x64 is OFF (64-bit constants break
+# Mosaic lowering and double HBM traffic for indices). Paddle's int64/float64
+# API dtypes are accepted everywhere but canonicalize to their 32-bit
+# counterparts at this boundary, matching XLA's own canonicalization —
+# silently, with no per-call JAX warning.
+_CANON64 = {
+    np.dtype(np.int64): int32,
+    np.dtype(np.uint64): jnp.uint32,
+    np.dtype(np.float64): float32,
+    np.dtype(np.complex128): complex64,
+}
+
 
 def convert_dtype(dtype):
     """Normalize a string / numpy / jax dtype spec to a numpy dtype-like."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
-        return _STR2DTYPE[dtype]
-    return np.dtype(dtype).type if not hasattr(dtype, 'dtype') else dtype
+        d = _STR2DTYPE[dtype]
+    elif hasattr(dtype, 'dtype'):
+        d = dtype
+    else:
+        d = np.dtype(dtype).type
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = _CANON64.get(np.dtype(d), d)
+    return d
 
 
 def dtype_name(dtype):

@@ -103,7 +103,7 @@ class Categorical(Distribution):
         shape = tuple(shape)
         out = jax.random.categorical(next_key(), self.logits, axis=-1,
                                      shape=shape + self.logits.shape[:-1])
-        return Tensor(out.astype(jnp.int64))
+        return Tensor(out.astype(jnp.int32))
 
     def _probs(self):
         return jax.nn.softmax(self.logits, axis=-1)

@@ -50,8 +50,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
                 batch = s0.shape[0]
             else:
                 batch = 1
-            tok = Tensor(jnp.full((batch,), decoder.start_token, jnp.int64))
-            ids = jnp.zeros((batch, 0), jnp.int64)
+            tok = Tensor(jnp.full((batch,), decoder.start_token, jnp.int32))
+            ids = jnp.zeros((batch, 0), jnp.int32)
             scores = jnp.zeros((batch,), jnp.float32)
         emb = decoder.embedding_fn(tok) if decoder.embedding_fn else tok
         out, state = cell(emb, state)
@@ -60,8 +60,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
         logp = jax.nn.log_softmax(logits_v.astype(jnp.float32), axis=-1)
         nxt = jnp.argmax(logp, axis=-1)
         scores = scores + jnp.take_along_axis(logp, nxt[:, None], axis=1)[:, 0]
-        ids = jnp.concatenate([ids, nxt[:, None].astype(jnp.int64)], axis=1)
-        tok = Tensor(nxt.astype(jnp.int64))
+        ids = jnp.concatenate([ids, nxt[:, None].astype(jnp.int32)], axis=1)
+        tok = Tensor(nxt.astype(jnp.int32))
         if bool(jnp.all(nxt == end)):
             break
     return Tensor(ids), Tensor(scores)

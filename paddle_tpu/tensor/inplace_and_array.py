@@ -62,4 +62,4 @@ def array_read(array, i):
 
 
 def array_length(array):
-    return Tensor(jnp.asarray(len(array), jnp.int64))
+    return Tensor(jnp.asarray(len(array), jnp.int32))

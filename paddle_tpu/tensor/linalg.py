@@ -153,7 +153,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):
         lo, hi = jnp.min(input), jnp.max(input)
     else:
         lo, hi = min, max
-    return jnp.histogram(input, bins=bins, range=(lo, hi))[0].astype(jnp.int64)
+    return jnp.histogram(input, bins=bins, range=(lo, hi))[0].astype(jnp.int32)
 
 
 @op

@@ -144,7 +144,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         # Gumbel top-k trick for sampling without replacement
         g = jax.random.gumbel(next_key(), v.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(out.astype(jnp.int64))
+    return Tensor(out.astype(jnp.int32))
 
 
 def bernoulli(x, name=None):

@@ -11,7 +11,7 @@ def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
     if axis is None:
         x = jnp.reshape(x, (-1,))
         axis = 0
-    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int32)
     return jnp.expand_dims(out, axis) if keepdim else out
 
 
@@ -20,14 +20,14 @@ def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
     if axis is None:
         x = jnp.reshape(x, (-1,))
         axis = 0
-    out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+    out = jnp.argmin(x, axis=axis).astype(jnp.int32)
     return jnp.expand_dims(out, axis) if keepdim else out
 
 
 @op
 def argsort(x, axis=-1, descending=False, name=None):
     out = jnp.argsort(-x if descending else x, axis=axis)
-    return out.astype(jnp.int64)
+    return out.astype(jnp.int32)
 
 
 @op
@@ -47,7 +47,7 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     _, idx = jax.lax.top_k(moved if largest else -moved, k)
     idx = jnp.moveaxis(idx, -1, ax)
     from .manipulation import take_along_axis
-    idx_t = Tensor(idx.astype(jnp.int64))
+    idx_t = Tensor(idx.astype(jnp.int32))
     vals = take_along_axis(x, idx_t, axis=ax) if isinstance(x, Tensor) else \
         Tensor(jnp.take_along_axis(v, idx, axis=ax))
     return vals, idx_t
@@ -104,7 +104,10 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
             jnp.reshape(sorted_sequence, (-1, sorted_sequence.shape[-1])),
             jnp.reshape(values, (-1, values.shape[-1])))
         out = jnp.reshape(out, values.shape)
-    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    # out_int32 kept for API parity but moot: x64 is off, so the int64
+    # branch would canonicalize to int32 anyway.
+    del out_int32
+    return out.astype(jnp.int32)
 
 
 def index_put(x, indices, value, accumulate=False):

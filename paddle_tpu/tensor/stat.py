@@ -56,7 +56,7 @@ def nanmean(x, axis=None, keepdim=False, name=None):
 
 @op
 def numel(x, name=None):
-    return jnp.asarray(x.size, jnp.int64)
+    return jnp.asarray(x.size, jnp.int32)
 
 
 @op
@@ -76,4 +76,4 @@ def mode(x, axis=-1, keepdim=False, name=None):
     if keepdim:
         vals = jnp.expand_dims(jnp.moveaxis(vals, -1, -1), axis)
         idx = jnp.expand_dims(idx, axis)
-    return vals, idx.astype(jnp.int64)
+    return vals, idx.astype(jnp.int32)

@@ -534,7 +534,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     _, path_rev = jax.lax.scan(backtrack, final_best, idxs, reverse=True)
     paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
                              final_best[:, None]], axis=1)
-    return Tensor(jnp.max(scores, -1)), Tensor(paths.astype(jnp.int64))
+    return Tensor(jnp.max(scores, -1)), Tensor(paths.astype(jnp.int32))
 
 
 class ViterbiDecoder:
