@@ -5,18 +5,26 @@ each grid program owns one query block in VMEM, streams key/value blocks,
 and never materializes the S×S score matrix in HBM (the reference's analogue
 is the fused CUDA attention in paddle/fluid/operators/fused/).
 
-Backward uses a blockwise jnp recompute (O(S·D) memory per block via scan)
-registered through jax.custom_vjp — functionally flash, XLA-scheduled.
+Backward is ALSO pallas (round 3): the classic two-kernel split — a dq
+kernel (each program owns a q block, streams k/v blocks) and a dk/dv kernel
+(each program owns a k/v block, streams q blocks) — recomputing p = exp(s -
+lse) from the saved log-sum-exp so the S×S matrix never hits HBM in training
+either. A jnp blockwise fallback remains behind PADDLE_TPU_FLASH_JNP_BWD=1.
+
+CPU testing: ``set_interpret(True)`` routes every pallas_call through the
+pallas interpreter so fwd+bwd run (slowly) anywhere; tests use this for
+numerics parity against naive attention.
 """
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
     _HAS_PALLAS = True
 except Exception:   # pragma: no cover
     _HAS_PALLAS = False
@@ -25,17 +33,26 @@ _BQ = 256
 _BK = 256
 _LANES = 128   # TPU lane width; lse is stored lane-broadcast to tile cleanly
 
+_INTERPRET = False   # run kernels through the pallas interpreter (CPU CI)
+
+
+def set_interpret(on):
+    """Enable pallas interpret mode so the kernels run on CPU (tests)."""
+    global _INTERPRET
+    _INTERPRET = bool(on)
+
 
 def flash_attention_available(q, k, v, mask):
     """Use the kernel for self-attention shapes that tile cleanly on TPU."""
     if not _HAS_PALLAS or mask is not None:
         return False
-    try:
-        dev = jax.devices()[0].platform.lower()
-    except Exception:
-        return False
-    if dev not in ('tpu', 'axon'):
-        return False
+    if not _INTERPRET:
+        try:
+            dev = jax.devices()[0].platform.lower()
+        except Exception:
+            return False
+        if dev not in ('tpu', 'axon'):
+            return False
     _, s_q, _, d = (int(x) for x in q.shape)
     s_k = int(k.shape[1])
     return (s_q == s_k and s_q % _BQ == 0 and s_k % _BK == 0 and
@@ -114,6 +131,7 @@ def _flash_fwd(q, k, v, causal):
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(q, k, v)
     return out, lse[:, :, 0]
 
@@ -157,6 +175,142 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, dq_ref, *,
+                   causal, scale, bq, bk):
+    """dq: each program owns one q block, streams k/v blocks.
+
+    Recomputes p = exp(s - lse) from the saved row log-sum-exp; constants
+    pinned f32/i32 for Mosaic (see forward kernel notes).
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * _np.float32(scale)      # [BQ, D]
+    g = g_ref[0].astype(jnp.float32)                           # [BQ, D]
+    lse = lse_ref[0][:, :1]                                    # [BQ, 1]
+    delta = dta_ref[0][:, :1]                                  # [BQ, 1]
+    nkb = k_ref.shape[1] // bk
+    d = q.shape[-1]
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq = dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dq
+
+    n_iter = jnp.asarray(nkb if not causal else (qi + 1) * (bq // bk),
+                         jnp.int32)
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq = jax.lax.fori_loop(jnp.int32(0), n_iter, body, dq0)
+    dq_ref[0] = (dq * _np.float32(scale)).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref,
+                    dk_ref, dv_ref, *, causal, scale, bq, bk):
+    """dk/dv: each program owns one k/v block, streams q blocks."""
+    ki = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)                        # [BK, D]
+    vblk = v_ref[0].astype(jnp.float32)
+    nqb = q_ref.shape[1] // bq
+    d = kblk.shape[-1]
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = (q_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
+             * _np.float32(scale))                             # [BQ, D]
+        g = g_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * bq, bq), :][:, :1]         # [BQ, 1]
+        delta = dta_ref[0, pl.ds(qb * bq, bq), :][:, :1]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dv = dv + jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: the first q block whose rows can attend to this k block
+    start = jnp.asarray((ki * bk) // bq if causal else 0, jnp.int32)
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, jnp.asarray(nqb, jnp.int32), body,
+                               (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, g, causal):
+    """Flash backward via the two-kernel pallas split; fp32 accumulation."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = sum_d o_i * do_i — cheap XLA elementwise; lane-broadcast so
+    # the kernels load 2-D [BQ, LANES] tiles (same trick as the fwd lse)
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), -1)
+    lse_b = jnp.broadcast_to(lse[:, :, None], (bh, s, _LANES))
+    dta_b = jnp.broadcast_to(delta[:, :, None], (bh, s, _LANES))
+
+    full = lambda b, i: (b, _np.int32(0), _np.int32(0))
+    blk = lambda b, i: (b, i, _np.int32(0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          bq=_BQ, bk=_BK),
+        grid=(bh, s // _BQ),
+        in_specs=[
+            pl.BlockSpec((1, _BQ, d), blk),          # q
+            pl.BlockSpec((1, s, d), full),           # k
+            pl.BlockSpec((1, s, d), full),           # v
+            pl.BlockSpec((1, _BQ, d), blk),          # g
+            pl.BlockSpec((1, _BQ, _LANES), blk),     # lse
+            pl.BlockSpec((1, _BQ, _LANES), blk),     # delta
+        ],
+        out_specs=pl.BlockSpec((1, _BQ, d), blk),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse_b, dta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          bq=_BQ, bk=_BK),
+        grid=(bh, s // _BK),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full),           # q
+            pl.BlockSpec((1, _BK, d), blk),          # k
+            pl.BlockSpec((1, _BK, d), blk),          # v
+            pl.BlockSpec((1, s, d), full),           # g
+            pl.BlockSpec((1, s, _LANES), full),      # lse
+            pl.BlockSpec((1, s, _LANES), full),      # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BK, d), blk),
+            pl.BlockSpec((1, _BK, d), blk),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse_b, dta_b)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash(q, k, v, causal):
     out, _ = _flash_fwd(q, k, v, causal)
@@ -170,7 +324,9 @@ def _flash_f(q, k, v, causal):
 
 def _flash_b(causal, res, g):
     q, k, v, out, lse = res
-    return _bwd_blockwise(q, k, v, out, lse, g, causal)
+    if os.environ.get('PADDLE_TPU_FLASH_JNP_BWD') == '1':
+        return _bwd_blockwise(q, k, v, out, lse, g, causal)
+    return _bwd_pallas(q, k, v, out, lse, g, causal)
 
 
 _flash.defvjp(_flash_f, _flash_b)

@@ -327,3 +327,37 @@ def test_zero_stage2_fleet_strategy():
     # stage 2 keeps params replicated (only grads/opt-state are sharded) —
     # the dp-sharded grad layout must not propagate into the updated params
     assert new_p['w'].sharding.is_fully_replicated
+
+
+def test_dp_with_flash_attention_interpret():
+    """VERDICT r2 #2: the distributed (dp) train step routed through the
+    pallas flash kernels (interpret mode on CPU) trains and matches the
+    non-flash step's loss on the same params/batch."""
+    import importlib
+    fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+    fa.set_interpret(True)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': 2}
+        topo = fleet.init(is_collective=True, strategy=strategy)
+        kw = dict(vocab_size=64, hidden_size=128, num_layers=2, num_heads=2,
+                  max_seq_len=256, dtype='float32', remat=False)
+        cfg_f = gpt.GPTConfig(use_flash=True, **kw)
+        cfg_n = gpt.GPTConfig(use_flash=False, **kw)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+
+        def one_step(cfg):
+            # fresh (deterministic) params per call: the train step donates
+            # its params/opt-state buffers
+            params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+            step = gpt.make_train_step(cfg, opt, topo.mesh)
+            state = opt.functional_init(params)
+            loss, _, _ = step(params, state, jax.random.PRNGKey(2),
+                              jnp.asarray(1e-3), toks, toks)
+            return float(loss)
+
+        np.testing.assert_allclose(one_step(cfg_f), one_step(cfg_n),
+                                   rtol=1e-4)
+    finally:
+        fa.set_interpret(False)

@@ -1,0 +1,141 @@
+"""Flash attention kernel coverage (VERDICT r2 #2): the pallas fwd + bwd
+kernels run through the pallas interpreter on CPU and are checked for
+numerics parity against naive attention, forward and gradient, causal and
+non-causal, d in {64, 128}.
+
+Reference analogue: fused attention under paddle/fluid/operators/fused/.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    fa.set_interpret(True)
+    yield
+    fa.set_interpret(False)
+
+
+def _naive(q, k, v, causal):
+    """Reference attention in plain jnp, [B, S, H, D] layout."""
+    b, s, h, d = q.shape
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    sc = jnp.einsum('bhqd,bhkd->bhqk', qt, kt) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(mask, sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', p, vt)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _rand_qkv(key, b, s, h, d, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (jax.random.normal(k1, shape, dtype),
+            jax.random.normal(k2, shape, dtype),
+            jax.random.normal(k3, shape, dtype))
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('d', [64, 128])
+def test_forward_parity(causal, d):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 1, 512, 2, d)
+    got = fa.flash_attention(q, k, v, causal=causal)
+    want = _naive(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('d', [64, 128])
+def test_grad_parity(causal, d):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 256, 2, d)
+    tgt = jax.random.normal(jax.random.PRNGKey(2), q.shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum((fa.flash_attention(q, k, v, causal=causal) - tgt)**2)
+
+    def loss_naive(q, k, v):
+        return jnp.sum((_naive(q, k, v, causal) - tgt)**2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_naive = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for gf, gn, name in zip(g_flash, g_naive, 'qkv'):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn),
+                                   atol=1e-3, rtol=1e-3,
+                                   err_msg=f'd{name} mismatch')
+
+
+def test_grad_parity_vs_jnp_bwd(monkeypatch):
+    """The pallas backward and the jnp blockwise backward agree exactly
+    on the same fwd residuals (same lse), so either path is safe."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 2, 256, 1, 64)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+    g_pallas = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv('PADDLE_TPU_FLASH_JNP_BWD', '1')
+    g_jnp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for gp, gj in zip(g_pallas, g_jnp):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gj),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_bfloat16_forward():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 256, 2, 64, jnp.bfloat16)
+    got = fa.flash_attention(q, k, v, causal=True)
+    want = _naive(q.astype(jnp.float32), k.astype(jnp.float32),
+                  v.astype(jnp.float32), True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=3e-2, rtol=3e-2)
+
+
+def test_availability_gate():
+    q = jnp.zeros((1, 512, 2, 64))
+    assert fa.flash_attention_available(q, q, q, None)       # interpret on
+    assert not fa.flash_attention_available(q, q, q, jnp.ones(1))  # mask
+    bad = jnp.zeros((1, 200, 2, 64))                         # 200 % 256 != 0
+    assert not fa.flash_attention_available(bad, bad, bad, None)
+    fa.set_interpret(False)
+    # off-TPU with interpret off -> unavailable
+    assert not fa.flash_attention_available(q, q, q, None)
+
+
+def test_gpt_layer_uses_flash_under_interpret():
+    """End-to-end: a GPT forward+backward with use_flash=True runs through
+    the pallas kernels in interpret mode and matches use_flash=False."""
+    from paddle_tpu.models import gpt
+
+    def run(use_flash):
+        cfg = gpt.GPTConfig(vocab_size=128, hidden_size=128, num_layers=2,
+                            num_heads=2, max_seq_len=256, dtype='float32',
+                            use_flash=use_flash, remat=False)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0, 128)
+
+        def loss_fn(p):
+            logits = gpt.forward(p, toks, cfg)
+            return jnp.mean((logits.astype(jnp.float32)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, grads
+
+    l_flash, g_flash = run(True)
+    l_ref, g_ref = run(False)
+    np.testing.assert_allclose(float(l_flash), float(l_ref), rtol=1e-4)
+    flat_f = jax.tree_util.tree_leaves(g_flash)
+    flat_r = jax.tree_util.tree_leaves(g_ref)
+    for a, b in zip(flat_f, flat_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3)
