@@ -346,8 +346,13 @@ def load(path, **configs):
         try:
             # load_saved_artifacts makes the exported/stale decision itself
             return TranslatedLayer(path)
-        except RuntimeError:
-            pass      # export failed at save time -> fall back to raw dict
+        except Exception as e:   # noqa: BLE001 — any deserialization failure
+            # (RuntimeError, OSError, ValueError, jax.export version skew...)
+            # degrades to the raw state dict rather than aborting the load
+            import warnings
+            warnings.warn(f'jit.load: standalone program at {path}.pdexec '
+                          f'unusable ({e.__class__.__name__}: {e}); '
+                          f'returning raw state dict')
     from ..framework_io import load as fload
     return fload(path + '.pdparams')
 

@@ -276,11 +276,13 @@ class _SyntheticTranslation(Dataset):
 
 
 def _load_wmt_tar(path, mode, src_dict_name, trg_dict_name, data_name,
-                  dict_size, max_len=80):
+                  src_dict_size, trg_dict_size=None, max_len=80):
     """Shared WMT tar parsing: *.dict members (one word per line, index =
     line number) + tab-separated parallel corpus members. Reference:
     python/paddle/text/datasets/wmt14.py _load_data."""
     import re
+    if trg_dict_size is None:
+        trg_dict_size = src_dict_size
     UNK, START, END = 2, '<s>', '<e>'
     pairs = []
     with tarfile.open(path) as tf:
@@ -296,10 +298,10 @@ def _load_wmt_tar(path, mode, src_dict_name, trg_dict_name, data_name,
                     return n
             return None
 
-        def to_dict(name):
+        def to_dict(name, size):
             d = {}
             for i, line in enumerate(tf.extractfile(name)):
-                if dict_size > 0 and i >= dict_size:
+                if size > 0 and i >= size:
                     break
                 d[line.decode('utf-8', 'replace').strip()] = i
             return d
@@ -309,8 +311,8 @@ def _load_wmt_tar(path, mode, src_dict_name, trg_dict_name, data_name,
                                            find(data_name))
         if src_name is None or trg_name is None or data_member is None:
             return None     # unexpected layout -> caller falls back
-        src_dict = to_dict(src_name)
-        trg_dict = to_dict(trg_name)
+        src_dict = to_dict(src_name, src_dict_size)
+        trg_dict = to_dict(trg_name, trg_dict_size)
         for line in tf.extractfile(data_member):
             parts = line.decode('utf-8', 'replace').strip().split('\t')
             if len(parts) != 2:
@@ -365,7 +367,7 @@ class WMT16(_SyntheticTranslation):
         if os.path.exists(data_file):
             loaded = _load_wmt_tar(
                 data_file, mode, f'{lang}.dict', f'{other}.dict',
-                'wmt16/{}'.format(mode), max(src_dict_size, trg_dict_size))
+                'wmt16/{}'.format(mode), src_dict_size, trg_dict_size)
         if loaded:
             self.pairs, self.src_dict, self.trg_dict = loaded
         else:

@@ -137,11 +137,17 @@ class Flowers(Dataset):
             if not os.path.isdir(os.path.join(self._data_path, 'jpg')):
                 os.makedirs(self._data_path, exist_ok=True)
                 with tarfile.open(data_file) as tf:
-                    tf.extractall(self._data_path)
+                    # filter='data' rejects absolute paths / .. traversal /
+                    # special members from an untrusted archive
+                    tf.extractall(self._data_path, filter='data')
             self.images = None
         else:
             n = 256 if mode == 'train' else 64
             self.images, self.labels = _synthetic_images(n, (64, 64, 3), 102, 2)
+            # real Flowers-102 labels are 1-based (1..102); keep the
+            # synthetic fallback consistent so downstream label-1 indexing
+            # behaves identically either way
+            self.labels = self.labels + 1
 
     def _read_jpg(self, index):
         from PIL import Image
@@ -177,7 +183,6 @@ class VOC2012(Dataset):
         self.transform = transform
         data_file = data_file or os.path.join(DATA_HOME, 'voc2012',
                                               'VOCtrainval_11-May-2012.tar')
-        self._tar = None
         if os.path.exists(data_file):
             self._data_file = data_file
             name = {'train': 'train', 'valid': 'val', 'test': 'val',
@@ -195,10 +200,19 @@ class VOC2012(Dataset):
 
     def _read(self, member):
         import io as _io
+        import threading
         from PIL import Image
-        if self._tar is None:
-            self._tar = tarfile.open(self._data_file)
-        f = self._tar.extractfile(member)
+        # one tar handle per (process, thread): TarFile seeks are stateful,
+        # so a handle shared across DataLoader workers (fork) or threads
+        # interleaves reads and returns corrupt members
+        if getattr(self, '_tls', None) is None \
+                or getattr(self, '_tls_pid', None) != os.getpid():
+            self._tls = threading.local()
+            self._tls_pid = os.getpid()
+        tar = getattr(self._tls, 'tar', None)
+        if tar is None:
+            tar = self._tls.tar = tarfile.open(self._data_file)
+        f = tar.extractfile(member)
         return Image.open(_io.BytesIO(f.read()))
 
     def __getitem__(self, idx):

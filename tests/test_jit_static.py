@@ -166,3 +166,32 @@ def test_amp_grad_flows_to_fp32_master():
     assert lin.weight.grad is not None
     import jax.numpy as jnp
     assert lin.weight.grad.dtype == jnp.float32
+
+
+def test_jit_load_corrupt_pdexec_falls_back_to_state_dict():
+    """ADVICE r1: jit.load must survive ANY deserialization failure of the
+    standalone program (not just RuntimeError) by warning and returning the
+    raw state dict."""
+    import warnings
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    with tempfile.TemporaryDirectory() as d:
+        net = Net()
+        net.eval()
+        path = os.path.join(d, 'corrupt')
+        spec = [paddle.static.InputSpec([None, 4], 'float32')]
+        paddle.jit.save(net, path, input_spec=spec)
+        with open(path + '.pdexec', 'wb') as f:
+            f.write(b'\x00garbage not a serialized program\xff')
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            loaded = paddle.jit.load(path)
+        assert isinstance(loaded, dict)
+        assert any('unusable' in str(x.message) for x in w)
