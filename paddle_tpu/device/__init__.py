@@ -101,3 +101,24 @@ def is_compiled_with_tpu():
 
 def device_count():
     return len(jax.devices())
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_cudnn_version():
+    """No cuDNN in the TPU stack (reference returns None when unavailable)."""
+    return None
+
+
+from . import cuda  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # ParallelEnv lives in distributed; resolve lazily to keep the top-level
+    # import light (distributed is a lazy subpackage)
+    if name == 'ParallelEnv':
+        from ..distributed import ParallelEnv
+        return ParallelEnv
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

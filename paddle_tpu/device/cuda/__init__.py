@@ -1,0 +1,85 @@
+"""paddle.device.cuda parity shims.
+Reference: python/paddle/device/cuda/__init__.py (+ streams.py).
+
+This framework targets TPU: there is no CUDA runtime, so these APIs keep
+the reference's signatures with honest TPU-backend semantics — XLA owns
+streams/allocation, device_count() counts *accelerators* (TPU chips), and
+synchronize() is a full-device barrier via a tiny block_until_ready.
+"""
+import jax
+
+__all__ = ['Stream', 'Event', 'current_stream', 'synchronize',
+           'device_count', 'empty_cache']
+
+
+class Stream:
+    """XLA schedules its own streams; this is an ordering no-op handle."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+def device_count():
+    """Number of local accelerator chips (TPU here, CUDA in the reference);
+    0 on a CPU-only host, matching the reference's semantics."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return 0
+    return sum(1 for d in devs if d.platform != 'cpu')
+
+
+def empty_cache():
+    """XLA's allocator holds its pool; nothing to drop eagerly."""
+
+
+def max_memory_allocated(device=None):
+    return 0
+
+
+def max_memory_reserved(device=None):
+    return 0
+
+
+def memory_allocated(device=None):
+    return 0
+
+
+def memory_reserved(device=None):
+    return 0

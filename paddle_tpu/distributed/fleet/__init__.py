@@ -24,7 +24,31 @@ from .strategy import DistributedStrategy  # noqa: F401
 from ..topology import HybridTopology, set_topology, get_topology, get_mesh
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import metrics  # noqa: F401
 from .utils import recompute  # noqa: F401
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+# dataset family is exported from fleet in the reference (fleet/__init__.py)
+from ..ps_dataset import (  # noqa: F401
+    _FileDatasetBase as DatasetBase, BoxPSDataset, InMemoryDataset,
+    QueueDataset)
+
+# topology aliases under the reference's names (fleet/base/topology.py)
+CommunicateTopology = HybridTopology
+HybridCommunicateGroup = HybridTopology
+
+
+class FileInstantDataset(QueueDataset):
+    """Reference: fleet/dataset FileInstantDataset — QueueDataset semantics
+    with per-file instant consumption; identical streaming here."""
+
+
+class Role:
+    """Reference: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     PipelineLayer, LayerDesc, get_rng_state_tracker)
@@ -174,13 +198,27 @@ class UserDefinedRoleMaker(RoleMakerBase):
         self.current_id = current_id
 
 
-# ---- UtilBase parity stubs ----
 class UtilBase:
-    def all_reduce(self, input, mode='sum'):
-        return input
+    """Reference: fleet/base/util_factory.py — cross-worker helpers. These
+    delegate to the real collective ops (eager identity on one process,
+    psum/pmax/pmin across jax processes under multi-host)."""
 
-    def barrier(self):
-        pass
+    def all_reduce(self, input, mode='sum', comm_world='worker'):
+        import jax.numpy as jnp
+        from .. import collective
+        # accept the reference's documented input forms (list / numpy / tensor)
+        return collective.all_reduce(jnp.asarray(input), op=mode)
+
+    def all_gather(self, input, comm_world='worker'):
+        import jax.numpy as jnp
+        from .. import collective
+        out = []
+        collective.all_gather(out, jnp.asarray(input))
+        return out
+
+    def barrier(self, comm_world='worker'):
+        from .. import collective
+        collective.barrier()
 
 
 util = UtilBase()

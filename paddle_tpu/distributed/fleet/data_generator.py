@@ -1,0 +1,83 @@
+"""fleet data generators: user-defined sample -> MultiSlot text protocol.
+Reference: python/paddle/distributed/fleet/data_generator/data_generator.py
+(DataGenerator.run_from_stdin writing "name:<n> v1..vn" slot lines consumed
+by the C++ feeders). TPU-native stand-in: same line protocol, consumed by
+ps_dataset._FileDatasetBase / io.DataLoader instead of a C++ feeder.
+"""
+import sys
+
+__all__ = ['MultiSlotDataGenerator', 'MultiSlotStringDataGenerator']
+
+
+class DataGenerator:
+    def __init__(self):
+        self._line_limit = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ------------------------------------------------------
+    def generate_sample(self, line):
+        """Override: return a generator yielding one parsed sample — a list
+        of (slot_name, [values]) tuples — per input line."""
+        raise NotImplementedError(
+            'implement generate_sample(line) in your DataGenerator subclass')
+
+    def generate_batch(self, samples):
+        """Override optionally: batch-level transform; defaults to echoing
+        each sample."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- protocol --------------------------------------------------------
+    def _gen_str(self, sample):
+        """Slot line: '<n> v1 ... vn' per slot — values rendered via str(),
+        so numeric and string slots share one code path."""
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return ' '.join(parts) + '\n'
+
+    def run_from_stdin(self):
+        self._run(sys.stdin, sys.stdout)
+
+    def run_from_memory(self, lines=()):
+        """Returns the protocol lines for ``lines`` (tests / local runs)."""
+        out = []
+
+        class _Sink:
+            def write(self, s):
+                out.append(s)
+
+        self._run(lines, _Sink())
+        return out
+
+    def _run(self, source, sink):
+        batch = []
+        for line in source:
+            g = self.generate_sample(line)
+            if g is None:
+                continue
+            for sample in g():
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch, sink)
+                    batch = []
+        if batch:
+            self._flush(batch, sink)
+
+    def _flush(self, batch, sink):
+        for sample in self.generate_batch(batch)():
+            sink.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots (ints/floats rendered with str())."""
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slots (values emitted verbatim)."""

@@ -1,0 +1,74 @@
+"""fleet.metrics: distributed metric reduction.
+Reference: python/paddle/distributed/fleet/metrics/metric.py (sum/max/min/
+auc/mae/rmse over an MPI/NCCL all-reduce). TPU-native: the same reductions
+over the collective all_reduce (eager identity single-process; psum across
+jax processes multi-host).
+"""
+import numpy as np
+
+__all__ = ['sum', 'max', 'min', 'auc', 'mae', 'rmse', 'mse', 'acc']
+
+def _reduce(value, mode):
+    import jax
+
+    arr = np.asarray(value, dtype='float64')
+    if jax.process_count() == 1:
+        # single-controller: reduction is the identity; stay in float64 so
+        # counts > 2^24 (routine for CTR stats) keep integer precision
+        return arr
+    import jax.numpy as jnp
+
+    from .. import collective
+    # multi-host: collective rides the device mesh, which is 32-bit (x64
+    # off). Counts above 2^24 lose precision here; acceptable for metric
+    # reporting, not for exact accounting.
+    return np.asarray(collective.all_reduce(jnp.asarray(arr, jnp.float32),
+                                            op=mode), dtype='float64')
+
+
+def sum(input, scope=None, util=None):
+    return _reduce(input, 'sum')
+
+
+def max(input, scope=None, util=None):
+    return _reduce(input, 'max')
+
+
+def min(input, scope=None, util=None):
+    return _reduce(input, 'min')
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative score histograms."""
+    pos = _reduce(stat_pos, 'sum').astype('float64')
+    neg = _reduce(stat_neg, 'sum').astype('float64')
+    # trapezoidal accumulation over score buckets, highest bucket first
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + float(pos[i])
+        new_fp = fp + float(neg[i])
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.5
+    return area / (tp * fp)
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    return float(_reduce(abserr, 'sum').sum()) / float(
+        _reduce(total_ins_num, 'sum').sum())
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(_reduce(sqrerr, 'sum').sum()) / float(
+        _reduce(total_ins_num, 'sum').sum())
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None):
+    return float(_reduce(correct, 'sum').sum()) / float(
+        _reduce(total, 'sum').sum())

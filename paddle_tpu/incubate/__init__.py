@@ -109,3 +109,46 @@ def graph_send_recv(x, src_index, dst_index, pool_type='sum', out_size=None):
             return base.at[seg].min(gathered)
         raise ValueError(pool_type)
     return apply_op(pure, x, src_index, dst_index)
+
+
+# ---- segment ops (reference: python/paddle/incubate/tensor/math.py) ------
+# TPU-native: jax.ops.segment_* lower to sorted scatter-adds that XLA
+# vectorizes; num_segments is taken from the ids (eager) so the API matches
+# the reference's dynamic behaviour.
+
+def _num_segments(segment_ids):
+    import numpy as np
+    ids = segment_ids._value if hasattr(segment_ids, '_value') else segment_ids
+    return int(np.asarray(ids.max())) + 1 if ids.size else 0
+
+
+@op
+def segment_sum(data, segment_ids, name=None):
+    import jax
+    return jax.ops.segment_sum(data, segment_ids,
+                               num_segments=_num_segments(segment_ids))
+
+
+@op
+def segment_mean(data, segment_ids, name=None):
+    import jax
+    n = _num_segments(segment_ids)
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids, data.dtype),
+                              segment_ids, num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(cnt.reshape(shape), 1)
+
+
+@op
+def segment_max(data, segment_ids, name=None):
+    import jax
+    return jax.ops.segment_max(data, segment_ids,
+                               num_segments=_num_segments(segment_ids))
+
+
+@op
+def segment_min(data, segment_ids, name=None):
+    import jax
+    return jax.ops.segment_min(data, segment_ids,
+                               num_segments=_num_segments(segment_ids))

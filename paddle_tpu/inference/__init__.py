@@ -192,3 +192,54 @@ def create_predictor(config):
 
 def convert_to_mixed_precision(*args, **kwargs):
     raise NotImplementedError('planned (round 2)')
+
+
+Tensor = Tensor_     # reference name (fluid/inference Tensor binding)
+
+
+class DataType:
+    """Reference: paddle_infer::DataType enum."""
+    FLOAT32 = 'float32'
+    FLOAT16 = 'float16'
+    INT64 = 'int64'
+    INT32 = 'int32'
+    UINT8 = 'uint8'
+    INT8 = 'int8'
+    BOOL = 'bool'
+
+
+_DTYPE_NBYTES = {DataType.FLOAT32: 4, DataType.FLOAT16: 2,
+                 DataType.INT64: 8, DataType.INT32: 4,
+                 DataType.UINT8: 1, DataType.INT8: 1, DataType.BOOL: 1}
+
+
+def get_num_bytes_of_data_type(dtype):
+    """Reference: paddle_infer::GetNumBytesOfDataType."""
+    return _DTYPE_NBYTES.get(dtype, np.dtype(dtype).itemsize)
+
+
+def get_version():
+    from ..version import full_version
+    return f'paddle_tpu inference {full_version} (XLA backend)'
+
+
+class PredictorPool:
+    """size-N pool of Predictors over one Config. The reference clones the
+    AnalysisPredictor per thread; XLA executables are thread-safe, so the
+    pool shares ONE compiled program and hands out independent feed/fetch
+    binding contexts — same API, far less memory."""
+
+    def __init__(self, config, size=1):
+        self._main = Predictor(config)
+        self._predictors = [self._main]
+        for _ in range(max(0, int(size) - 1)):
+            clone = Predictor.__new__(Predictor)
+            clone.__dict__.update(self._main.__dict__)
+            clone._feed = {}
+            clone._results = {}
+            self._predictors.append(clone)
+
+    def retrive(self, idx):      # reference spells it 'retrive'
+        return self._predictors[idx]
+
+    retrieve = retrive

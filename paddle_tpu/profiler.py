@@ -107,3 +107,41 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         jax.profiler.stop_trace()
     except Exception:
         pass
+
+
+class ProfilerOptions:
+    """Reference: python/paddle/utils/profiler.py ProfilerOptions — a dict
+    of knobs; only the subset meaningful for jax.profiler is honored."""
+
+    DEFAULT = {'state': 'All', 'sorted_key': 'default',
+               'tracer_level': 'Default', 'batch_range': [0, 10],
+               'output_thread_detail': False, 'profile_path': 'none',
+               'timeline_path': 'none', 'op_summary_path': 'none'}
+
+    def __init__(self, options=None):
+        self._options = dict(self.DEFAULT)
+        if options:
+            self._options.update(options)
+
+    def with_state(self, state):
+        new = ProfilerOptions(self._options)
+        new._options['state'] = state
+        return new
+
+    def __getitem__(self, name):
+        return self._options[name]
+
+
+_profiler_singleton = None
+
+
+def get_profiler(options=None):
+    """Process-wide Profiler singleton (reference utils/profiler.py)."""
+    global _profiler_singleton
+    if _profiler_singleton is None:
+        opts = options if isinstance(options, ProfilerOptions) \
+            else ProfilerOptions(options)
+        _profiler_singleton = Profiler(
+            log_dir=opts['profile_path'] if opts['profile_path'] != 'none'
+            else './profiler_log')
+    return _profiler_singleton

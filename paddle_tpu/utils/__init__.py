@@ -1,5 +1,20 @@
+"""paddle.utils parity surface.
+Reference: python/paddle/utils/__init__.py (deprecated, run_check,
+require_version, try_import, unique_name, download, dlpack, cpp_extension,
+Profiler/ProfilerOptions/get_profiler).
+"""
 from . import misc  # noqa: F401
 from .misc import in_dynamic_mode, enable_static, disable_static  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import download  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+__all__ = ['deprecated', 'run_check', 'require_version', 'try_import']
 
 
 def try_import(name):
@@ -10,4 +25,21 @@ def try_import(name):
         raise ImportError(f'{name} is required but not installed '
                           '(no-egress environment: gate this feature)') from e
 
-from . import checkpoint  # noqa: F401
+
+def require_version(min_version, max_version=None):
+    """Check the installed paddle_tpu version is within [min, max].
+    Reference: fluid/framework.py require_version."""
+    from .. import version as _v
+
+    def parse(s):
+        parts = str(s).split('.')
+        return tuple(int(''.join(c for c in p if c.isdigit()) or 0)
+                     for p in parts[:3])
+
+    cur = parse(_v.full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f'paddle_tpu version {_v.full_version} < required {min_version}')
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f'paddle_tpu version {_v.full_version} > allowed {max_version}')

@@ -1,0 +1,51 @@
+"""paddle.utils.cpp_extension: build + load C++ extensions at runtime.
+Reference: python/paddle/utils/cpp_extension/ (setuptools-based custom-op
+builder with JIT ``load``).
+
+TPU-native: device compute belongs to XLA/Pallas, so C++ extensions here
+are HOST-side (data pipeline / custom samplers / runtime helpers — the same
+role as native/dataloader.cpp). ``load`` compiles sources with g++ into a
+shared library and returns a ctypes.CDLL; no pybind11 (not in the image).
+"""
+import os
+import subprocess
+import sysconfig
+
+__all__ = ['load', 'CppExtension', 'get_build_directory']
+
+_BUILD_ROOT = os.path.expanduser('~/.cache/paddle_tpu/extensions')
+
+
+def get_build_directory():
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+def CppExtension(sources, *args, **kwargs):
+    """setuptools.Extension for a host-side C++ op (parity shim: returns the
+    kwargs needed by ``load``; use setup(ext_modules=...) flows directly
+    with setuptools for packaged builds)."""
+    return {'sources': sources, 'args': args, 'kwargs': kwargs}
+
+
+def load(name, sources, extra_cxx_flags=None, extra_ldflags=None,
+         build_directory=None, verbose=False):
+    """Compile ``sources`` into ``<build_dir>/<name>.so`` (skipped when
+    up-to-date) and return it loaded via ctypes."""
+    import ctypes
+
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f'{name}.so')
+    srcs = [os.path.abspath(s) for s in sources]
+    stale = (not os.path.exists(out) or
+             any(os.path.getmtime(s) > os.path.getmtime(out) for s in srcs))
+    if stale:
+        cmd = (['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
+                '-I' + sysconfig.get_paths()['include']]
+               + (extra_cxx_flags or []) + srcs + ['-o', out]
+               + (extra_ldflags or ['-lpthread']))
+        if verbose:
+            print(' '.join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)

@@ -1,0 +1,32 @@
+"""paddle.utils.deprecated decorator.
+Reference: python/paddle/utils/deprecated.py (decorator emitting
+DeprecationWarning and annotating the docstring)."""
+import functools
+import warnings
+
+__all__ = ['deprecated']
+
+
+def deprecated(update_to='', since='', reason=''):
+    """Mark an API deprecated: warns once per call site and prepends a
+    deprecation note to the wrapped function's docstring."""
+
+    def decorator(func):
+        note = 'Warning: API "{}.{}" is deprecated'.format(
+            func.__module__, func.__name__)
+        if since:
+            note += f' since {since}'
+        if update_to:
+            note += f', and will be removed in the future. Use "{update_to}" instead'
+        if reason:
+            note += f'. Reason: {reason}'
+        func.__doc__ = note + '\n\n' + (func.__doc__ or '')
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(note, category=DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

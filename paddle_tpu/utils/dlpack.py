@@ -1,0 +1,46 @@
+"""paddle.utils.dlpack: zero-copy tensor interchange.
+Reference: python/paddle/utils/dlpack.py (to_dlpack/from_dlpack).
+
+TPU-native: jax arrays implement the standard ``__dlpack__`` protocol.
+``to_dlpack`` returns a reusable carrier object exposing ``__dlpack__`` /
+``__dlpack_device__`` (consumable by torch/numpy/jax ``from_dlpack``);
+legacy one-shot PyCapsules from older producers are accepted by
+``from_dlpack`` via a torch bridge, since jax >= 0.5 only consumes
+protocol objects.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ['to_dlpack', 'from_dlpack']
+
+
+class _DLPackCarrier:
+    """Protocol-object view of a tensor (reusable, unlike a raw capsule)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._value.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._value.__dlpack_device__()
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack interchange object (zero-copy where possible)."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return _DLPackCarrier(v)
+
+
+def from_dlpack(dlpack):
+    """DLPack object (protocol object or legacy capsule) -> Tensor."""
+    import jax
+    if hasattr(dlpack, '__dlpack__'):
+        return Tensor(jax.dlpack.from_dlpack(dlpack))
+    # legacy PyCapsule (e.g. torch.utils.dlpack.to_dlpack output): consume
+    # it through torch, whose from_dlpack still takes capsules, then hand
+    # the protocol-object torch tensor to jax
+    import torch.utils.dlpack as tdl
+    return Tensor(jax.dlpack.from_dlpack(tdl.from_dlpack(dlpack)))

@@ -1,0 +1,73 @@
+"""paddle.utils.download: dataset/weights path resolution.
+Reference: python/paddle/utils/download.py (get_weights_path_from_url /
+get_path_from_url with md5 check + decompress).
+
+This deployment is zero-egress: URLs resolve against the local cache
+(``~/.cache/paddle_tpu/<basename>``) that an operator pre-populates; a
+missing cache entry raises with the exact path to provision instead of
+attempting a network fetch. md5 verification and tar/zip decompression
+behave like the reference.
+"""
+import hashlib
+import os
+import tarfile
+import zipfile
+
+__all__ = ['get_weights_path_from_url']
+
+WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle_tpu/weights')
+DOWNLOAD_HOME = os.path.expanduser('~/.cache/paddle_tpu/downloads')
+
+
+def is_url(path):
+    return isinstance(path, str) and path.startswith(('http://', 'https://'))
+
+
+def _md5check(path, md5sum):
+    if not md5sum:
+        return True
+    h = hashlib.md5()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _decompress(path):
+    target = os.path.splitext(path)[0]
+    if path.endswith(('.tar.gz', '.tgz', '.tar')):
+        if not os.path.isdir(target):
+            with tarfile.open(path) as tf:
+                try:
+                    tf.extractall(target, filter='data')
+                except TypeError:   # pre-3.10.12/3.11.4: no filter kwarg
+                    tf.extractall(target)
+        return target
+    if path.endswith('.zip'):
+        if not os.path.isdir(target):
+            with zipfile.ZipFile(path) as zf:
+                zf.extractall(target)
+        return target
+    return path
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True):
+    """Resolve url -> local file; zero-egress, cache-only (see module doc)."""
+    root_dir = root_dir or DOWNLOAD_HOME
+    if not is_url(url):        # already a local path
+        path = url
+    else:
+        path = os.path.join(root_dir, url.split('/')[-1])
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f'{path} not found and network fetch is disabled (zero-egress '
+            f'deployment). Provision the file at that path to use {url!r}.')
+    if not _md5check(path, md5sum):
+        raise IOError(f'{path} md5 mismatch (expected {md5sum})')
+    return _decompress(path) if decompress else path
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Weights cache lookup (reference behaviour minus the fetch)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum, decompress=False)

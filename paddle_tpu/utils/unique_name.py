@@ -1,0 +1,50 @@
+"""paddle.utils.unique_name: per-prefix name generation with guard scopes.
+Reference: python/paddle/fluid/unique_name.py (generate/switch/guard)."""
+import contextlib
+
+__all__ = ['generate', 'switch', 'guard']
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=None):
+        self.ids = {}
+        self.prefix = prefix or ''
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return '_'.join(filter(None, [self.prefix, key, str(n)]))
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """'fc' -> 'fc_0', 'fc_1', ... (scoped by the active generator)."""
+    return _generator(key)
+
+
+def generate_with_ignorable_key(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the active generator; returns the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh (or given prefix's) generator; restores on exit."""
+    if isinstance(new_generator, (str, bytes)):
+        if isinstance(new_generator, bytes):
+            new_generator = new_generator.decode()
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

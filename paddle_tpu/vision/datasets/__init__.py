@@ -139,7 +139,10 @@ class Flowers(Dataset):
                 with tarfile.open(data_file) as tf:
                     # filter='data' rejects absolute paths / .. traversal /
                     # special members from an untrusted archive
-                    tf.extractall(self._data_path, filter='data')
+                    try:
+                        tf.extractall(self._data_path, filter='data')
+                    except TypeError:   # pre-3.10.12/3.11.4: no filter kwarg
+                        tf.extractall(self._data_path)
             self.images = None
         else:
             n = 256 if mode == 'train' else 64
