@@ -42,6 +42,11 @@ class StaticFunction:
     """Compiled wrapper around a Python function / Layer.forward."""
 
     def __init__(self, function, input_spec=None):
+        if not getattr(function, '_not_to_static', False):
+            # dy2static pass: rewrite tensor-conditioned if/while into
+            # lax.cond / lax.while_loop (no-op for control-flow-free fns)
+            from .dy2static import convert_control_flow
+            function = convert_control_flow(function)
         self._fn = function
         self._input_spec = input_spec
         self._layer = getattr(function, '__self__', None)

@@ -1,0 +1,476 @@
+"""Dynamic-to-static control-flow conversion (dy2static).
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+(ifelse_transformer.py, loop_transformer.py, convert_operators.py): the
+reference rewrites Python ``if``/``while`` on tensors into cond/while ops in
+its ProgramDesc. TPU-native: the same AST rewrite, but targeting
+``lax.cond`` / ``lax.while_loop`` — XLA's native structured control flow —
+with a runtime dispatch that preserves plain-Python semantics whenever the
+condition is NOT a traced tensor, so eager behaviour is unchanged.
+
+Scope (minimal viable, VERDICT r2 #4): tensor-conditioned ``if``/``else``
+and ``while`` with single-assignment bodies. Unsupported constructs
+(return/break escaping a tensor branch, attribute/subscript stores, a var
+bound in only one branch) raise Dy2StaticError with an actionable message
+instead of jax's TracerBoolConversionError.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ['convert_control_flow', 'Dy2StaticError']
+
+_RT_NAME = '_pt_dy2st'          # name the runtime is injected under
+_GEN_PREFIX = '_pt_'            # prefix of every generated symbol
+
+
+class Dy2StaticError(Exception):
+    pass
+
+
+class _Undef:
+    """Sentinel for 'name unbound before the control-flow statement'."""
+
+    def __repr__(self):
+        return '<undefined>'
+
+
+UNDEF = _Undef()
+
+
+# --------------------------------------------------------------------------
+# runtime conversion ops (reference: convert_operators.convert_ifelse/...)
+# --------------------------------------------------------------------------
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _to_py_bool(pred):
+    p = _unwrap(pred)
+    if isinstance(p, (jax.Array, np.ndarray, np.generic)):
+        if p.size != 1:
+            raise Dy2StaticError(
+                f'condition must be a scalar tensor, got shape {p.shape}')
+        return bool(np.asarray(p).reshape(()))
+    return bool(p)     # plain Python truthiness (lists, None, ints, ...)
+
+
+def _check_bound(names, values, stmt):
+    for n, v in zip(names, values):
+        if v is UNDEF:
+            raise Dy2StaticError(
+                f"variable '{n}' is used after a tensor-dependent {stmt} "
+                f"but is not bound before it (and, for if/else, not in "
+                f"both branches). Initialize '{n}' before the {stmt} so "
+                f"both paths produce the same variables.")
+
+
+def convert_ifelse(pred, true_fn, false_fn, names, init_vals):
+    """if/else on ``pred``: lax.cond when traced, plain Python otherwise."""
+    if not _is_traced(pred):
+        return true_fn(*init_vals) if _to_py_bool(pred) else \
+            false_fn(*init_vals)
+    # vars unbound BEFORE the if are fine as long as both branches bind
+    # them (checked on the branch outputs); they ride the closure, not the
+    # lax.cond operands, since UNDEF is not a jax type
+    bound_idx = [i for i, v in enumerate(init_vals) if v is not UNDEF]
+    u_init = tuple(_unwrap(init_vals[i]) for i in bound_idx)
+
+    def _branch(fn):
+        def run(u_vals):
+            full = list(init_vals)
+            for j, i in enumerate(bound_idx):
+                full[i] = (Tensor(u_vals[j])
+                           if isinstance(init_vals[i], Tensor) else u_vals[j])
+            outs = fn(*full)
+            _check_bound(names, outs, 'if/else')
+            return tuple(_unwrap(o) for o in outs)
+        return run
+
+    try:
+        outs = jax.lax.cond(_unwrap(pred), _branch(true_fn),
+                            _branch(false_fn), u_init)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f'the two branches of a tensor-dependent if/else must produce '
+            f'matching shapes/dtypes for {names}; ({e})') from e
+    return tuple(Tensor(o) if isinstance(o, (jax.Array, jax.core.Tracer))
+                 else o for o in outs)
+
+
+def convert_while(cond_fn, body_fn, names, init_vals):
+    """while loop: lax.while_loop when the condition traces, else Python."""
+    first = cond_fn(*init_vals)
+    if not _is_traced(first) and not any(_is_traced(v) for v in init_vals):
+        # reuse `first` for the first test: re-evaluating would double any
+        # side effects in the condition expression
+        vals = tuple(init_vals)
+        cont = _to_py_bool(first)
+        while cont:
+            vals = tuple(body_fn(*vals))
+            cont = _to_py_bool(cond_fn(*vals))
+        return vals
+
+    _check_bound(names, init_vals, 'while')
+    u_init = tuple(_unwrap(v) for v in init_vals)
+
+    def rewrap(u_vals):
+        return tuple(Tensor(u) if isinstance(orig, Tensor) else u
+                     for orig, u in zip(init_vals, u_vals))
+
+    def u_cond(u_vals):
+        return _unwrap(cond_fn(*rewrap(u_vals)))
+
+    def u_body(u_vals):
+        outs = body_fn(*rewrap(u_vals))
+        _check_bound(names, outs, 'while')
+        return tuple(_unwrap(o) for o in outs)
+
+    try:
+        outs = jax.lax.while_loop(u_cond, u_body, u_init)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f'loop variables {names} of a tensor-dependent while must keep '
+            f'the same shape/dtype every iteration; ({e})') from e
+    return tuple(Tensor(o) if isinstance(o, (jax.Array, jax.core.Tracer))
+                 else o for o in outs)
+
+
+def unsupported_guard(pred, reason):
+    """Evaluated on conditions we could not rewrite: plain Python passes
+    through untouched; a traced condition gets an actionable error."""
+    if _is_traced(pred):
+        raise Dy2StaticError(
+            f'tensor-dependent control flow not convertible: {reason}. '
+            f'Refactor so the branch/loop body only rebinds local '
+            f'variables (no return/break/continue escaping it, no '
+            f'attribute or subscript stores).')
+    return pred
+
+
+# --------------------------------------------------------------------------
+# static analysis
+# --------------------------------------------------------------------------
+
+_INNER_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                 ast.GeneratorExp)
+
+
+class _BodyInfo(ast.NodeVisitor):
+    """Names bound by a statement list + escape/store-form diagnostics."""
+
+    def __init__(self):
+        self.assigned = set()
+        self.complex_store = False     # a.b = / a[i] = inside the body
+        self.escapes = False           # return, or break/continue that would
+        self._loop_depth = 0           # leave the analyzed region
+
+    def run(self, stmts):
+        for s in stmts:
+            self.visit(s)
+        return self
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.assigned.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        else:                          # Attribute / Subscript store
+            self.complex_store = True
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+            self.visit(node.value)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self._loop_depth += 1
+        for s in node.body + node.orelse:
+            self.visit(s)
+        self._loop_depth -= 1
+
+    def visit_While(self, node):
+        self._loop_depth += 1
+        for s in node.body + node.orelse:
+            self.visit(s)
+        self._loop_depth -= 1
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._target(item.optional_vars)
+        for s in node.body:
+            self.visit(s)
+
+    def visit_Return(self, node):
+        self.escapes = True
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.escapes = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.escapes = True
+
+    def generic_visit(self, node):
+        if isinstance(node, _INNER_SCOPES):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.assigned.add(node.name)
+            return                     # inner scope: bindings don't leak
+        super().generic_visit(node)
+
+
+def _mods_of(*stmt_lists):
+    """User-visible names rebound by the statement lists, or None when the
+    region cannot be converted (escaping control flow / complex stores)."""
+    names = set()
+    for stmts in stmt_lists:
+        info = _BodyInfo().run(stmts)
+        if info.escapes or info.complex_store:
+            return None
+        names |= info.assigned
+    return sorted(n for n in names if not n.startswith(_GEN_PREFIX))
+
+
+# --------------------------------------------------------------------------
+# AST rewriting
+# --------------------------------------------------------------------------
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _rt_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_load(_RT_NAME), attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _sentinel_reads(mods, uid):
+    """try: _pt_inK = v / except NameError: _pt_inK = UNDEF — one per var."""
+    stmts, names = [], []
+    for i, v in enumerate(mods):
+        tmp = f'{_GEN_PREFIX}in{i}_{uid}'
+        names.append(tmp)
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[_store(tmp)], value=_load(v))],
+            handlers=[ast.ExceptHandler(
+                type=_load('NameError'), name=None,
+                body=[ast.Assign(
+                    targets=[_store(tmp)],
+                    value=ast.Attribute(value=_load(_RT_NAME), attr='UNDEF',
+                                        ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return stmts, names
+
+
+def _func_def(name, params, body, returns):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], kwonlyargs=[], kw_defaults=[],
+                           defaults=[],
+                           args=[ast.arg(arg=p) for p in params]),
+        body=body + [ast.Return(value=ast.Tuple(
+            elts=[_load(r) for r in returns], ctx=ast.Load()))],
+        decorator_list=[], type_params=[])
+
+
+def _names_tuple(mods):
+    return ast.Tuple(elts=[ast.Constant(value=m) for m in mods],
+                     ctx=ast.Load())
+
+
+def _undef_dels(mods):
+    """`if v is UNDEF: del v` per var — restores exact Python semantics
+    (later reads raise UnboundLocalError) when the taken non-traced branch
+    left a variable unbound."""
+    out = []
+    for m in mods:
+        out.append(ast.If(
+            test=ast.Compare(
+                left=_load(m), ops=[ast.Is()],
+                comparators=[ast.Attribute(value=_load(_RT_NAME),
+                                           attr='UNDEF', ctx=ast.Load())]),
+            body=[ast.Delete(targets=[ast.Name(id=m, ctx=ast.Del())])],
+            orelse=[]))
+    return out
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    # -- if/else ---------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        mods = _mods_of(node.body, node.orelse)
+        if mods is None or not mods:
+            # not convertible (or pure side-effect): keep Python `if`, but
+            # make a traced condition fail with a clear message
+            reason = ('branch contains return/break/continue or attribute/'
+                      'subscript stores' if mods is None
+                      else 'branch rebinds no local variables')
+            node.test = _rt_call('unsupported_guard',
+                                 [node.test, ast.Constant(value=reason)])
+            return node
+        uid = self._next()
+        tname, fname = f'{_GEN_PREFIX}t_{uid}', f'{_GEN_PREFIX}f_{uid}'
+        sent, tmp_names = _sentinel_reads(mods, uid)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(m) for m in mods],
+                               ctx=ast.Store())],
+            value=_rt_call('convert_ifelse', [
+                _load(f'{_GEN_PREFIX}c_{uid}'), _load(tname), _load(fname),
+                _names_tuple(mods),
+                ast.Tuple(elts=[_load(t) for t in tmp_names],
+                          ctx=ast.Load())]))
+        return [
+            ast.Assign(targets=[_store(f'{_GEN_PREFIX}c_{uid}')],
+                       value=node.test),
+            _func_def(tname, mods, node.body, mods),
+            _func_def(fname, mods, node.orelse or [ast.Pass()], mods),
+            *sent, call, *_undef_dels(mods),
+        ]
+
+    # -- while -----------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        mods = _mods_of(node.body)
+        if mods is None or not mods or node.orelse:
+            reason = ('while has an else clause' if node.orelse else
+                      'body contains return/break/continue or attribute/'
+                      'subscript stores' if mods is None
+                      else 'body rebinds no local variables')
+            node.test = _rt_call('unsupported_guard',
+                                 [node.test, ast.Constant(value=reason)])
+            return node
+        uid = self._next()
+        cname, bname = f'{_GEN_PREFIX}wc_{uid}', f'{_GEN_PREFIX}wb_{uid}'
+        sent, tmp_names = _sentinel_reads(mods, uid)
+        cond_fn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[], kwonlyargs=[], kw_defaults=[],
+                               defaults=[],
+                               args=[ast.arg(arg=p) for p in mods]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(m) for m in mods],
+                               ctx=ast.Store())],
+            value=_rt_call('convert_while', [
+                _load(cname), _load(bname), _names_tuple(mods),
+                ast.Tuple(elts=[_load(t) for t in tmp_names],
+                          ctx=ast.Load())]))
+        return [cond_fn, _func_def(bname, mods, node.body, mods),
+                *sent, call, *_undef_dels(mods)]
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+def _has_control_flow(tree):
+    return any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(tree))
+
+
+def convert_control_flow(fn):
+    """Rewrite tensor-conditioned if/while in ``fn`` (best effort).
+
+    Returns ``fn`` unchanged when it has no control flow, its source is
+    unavailable (C functions, REPL lambdas), or the rewrite fails — plain
+    jax.jit tracing then applies, exactly as before.
+    """
+    bound_self = getattr(fn, '__self__', None)
+    raw = fn.__func__ if bound_self is not None else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _has_control_flow(fdef):
+        return fn
+    fdef.decorator_list = []           # avoid re-entering to_static on exec
+    try:
+        _ControlFlowTransformer().visit(fdef)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f'<dy2static:{raw.__name__}>',
+                       mode='exec')
+        # globals DELEGATE to the live module namespace so helpers defined
+        # (or monkeypatched) after decoration still resolve; only the
+        # runtime alias and closure snapshot shadow it
+        glb = _LiveGlobals(raw.__globals__)
+        glb[_RT_NAME] = _runtime_namespace()
+        if raw.__closure__:
+            # re-exec'ing a def cannot rebuild cells: snapshot the captured
+            # values (static capture — documented limitation); an empty cell
+            # (sibling defined later) aborts conversion via the fallback
+            glb.update(zip(raw.__code__.co_freevars,
+                           (c.cell_contents for c in raw.__closure__)))
+        exec(code, glb)                # noqa: S102 — controlled source
+        new_fn = functools.wraps(raw)(glb[raw.__name__])
+    except Exception as e:             # noqa: BLE001 — never break tracing
+        warnings.warn(f'dy2static: could not convert control flow in '
+                      f'{raw.__name__} ({e}); falling back to plain tracing')
+        return fn
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn
+
+
+class _LiveGlobals(dict):
+    """exec-globals that fall through to the function's real module globals
+    (CPython honors __missing__ for dict subclasses in LOAD_GLOBAL)."""
+
+    def __init__(self, live):
+        super().__init__()
+        self['__builtins__'] = live.get('__builtins__', __builtins__)
+        self._live = live
+
+    def __missing__(self, key):
+        return self._live[key]
+
+
+class _runtime_namespace:
+    UNDEF = UNDEF
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_while = staticmethod(convert_while)
+    unsupported_guard = staticmethod(unsupported_guard)
