@@ -109,8 +109,36 @@ class _DistributedOptimizer:
     merge, and recompute markers consumed by parallelize()."""
 
     def __init__(self, opt, strategy):
+        if strategy is not None and strategy.lars:
+            opt = self._wrap_lars(opt, strategy)
         self._inner = opt
         self._strategy = strategy
+
+    @staticmethod
+    def _wrap_lars(opt, strategy):
+        """strategy.lars swaps a Momentum/SGD inner optimizer for LARS
+        (reference: fleet/meta_optimizers/lars_optimizer.py)."""
+        from ... import optimizer as opt_mod
+        if not isinstance(opt, (opt_mod.Momentum, opt_mod.SGD)):
+            return opt
+        cfg = strategy.lars_configs
+        return opt_mod.LarsMomentum(
+            learning_rate=opt._lr,
+            momentum=getattr(opt, '_momentum', 0.9),
+            lars_coeff=cfg.lars_coeff or 0.001,
+            lars_weight_decay=cfg.lars_weight_decay or 0.0005,
+            epsilon=cfg.epsilon or 1e-9,
+            exclude_from_weight_decay=cfg.exclude_from_weight_decay,
+            parameters=opt._parameters, grad_clip=opt._grad_clip)
+
+    def make_localsgd_step(self, loss_fn, mesh=None):
+        """strategy.localsgd: build the k-local-steps-then-average train
+        step (see parallel/localsgd.py). loss_fn(params, batch) -> scalar."""
+        from ...parallel.localsgd import make_localsgd_train_step
+        mesh = mesh or get_mesh()
+        k = self._strategy.localsgd_configs.k_steps or 4
+        return make_localsgd_train_step(loss_fn, self._inner, mesh,
+                                        k_steps=k)
 
     def __getattr__(self, k):
         return getattr(self._inner, k)

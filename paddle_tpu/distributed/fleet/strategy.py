@@ -34,7 +34,13 @@ class DistributedStrategy:
                                    sharding_degree=1, sp_degree=1, ep_degree=1)
         self.lamb = False
         self.lars = False
+        self.lars_configs = _Cfg(lars_coeff=0.001, lars_weight_decay=0.0005,
+                                 epsilon=1e-9, exclude_from_weight_decay=[])
         self.localsgd = False
+        self.localsgd_configs = _Cfg(k_steps=4, begin_step=1)
+        # DGC and fp16_allreduce are NCCL-bandwidth workarounds; on a TPU
+        # mesh collectives ride ICI and XLA already all-reduces in the
+        # compute dtype, so both are accepted-but-N/A (documented SURVEY §2)
         self.dgc = False
         self.fp16_allreduce = False
         self.find_unused_parameters = False

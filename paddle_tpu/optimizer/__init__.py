@@ -197,3 +197,36 @@ class Lamb(Optimizer):
         p_new = p - lr.astype(p.dtype) * trust * r
         return p_new, {'moment1': m, 'moment2': v, 'beta1_pow': b1p,
                        'beta2_pow': b2p}
+
+
+class LarsMomentum(Momentum):
+    """LARS: layer-wise adaptive rate scaling over momentum.
+    Reference: fluid/optimizer.py LarsMomentumOptimizer and the lars_momentum
+    op — velocity = mu*velocity + local_lr*(g + wd*p); p -= velocity, with
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + wd*||p|| + eps)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, exclude_from_weight_decay=None, name=None):
+        # weight decay is applied inside the LARS update, not the base class
+        super().__init__(learning_rate, momentum, parameters,
+                         use_nesterov=False, weight_decay=None,
+                         grad_clip=grad_clip, name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _update(self, g, p, state, lr):
+        lr = lr.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        wd = jnp.float32(self._lars_wd)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm
+            / (g_norm + wd * w_norm + self._lars_eps),
+            lr)
+        v = (self._momentum * state['velocity']
+             + local_lr.astype(p.dtype) * (g + wd.astype(p.dtype) * p))
+        return p - v, {'velocity': v}
