@@ -4,10 +4,16 @@
 TPU-native: one process per HOST (JAX single-controller per host drives all
 local chips). The launcher execs the training script once per host via the
 same env-var contract as the reference (PADDLE_TRAINER_ID / TRAINERS_NUM /
-MASTER), plus a watchdog that restarts the child on failure up to
---max_restarts (elastic role), resuming from the latest checkpoint the
-script writes (orbax/hapi save). On a pod slice, run this on every host
-(GKE/xmanager provide the env).
+MASTER), plus an elastic watchdog with TWO failure detectors:
+ - exit watch: restart on nonzero child exit (up to --max_restarts);
+ - liveness watch: the framework touches a heartbeat file every train step
+   (hapi.Model train steps call ``touch_heartbeat``; custom loops may call
+   it directly). If the file goes stale for longer than
+   --heartbeat_timeout the child is presumed hung (e.g. a dead device
+   tunnel blocking inside a collective — exit codes never fire for those),
+   SIGTERM'd, then SIGKILL'd, and restarted. Resume comes from the latest
+   checkpoint the script wrote (orbax/hapi save).
+On a pod slice, run this on every host (GKE/xmanager provide the env).
 """
 import argparse
 import os
@@ -16,8 +22,22 @@ import subprocess
 import sys
 import time
 
+HEARTBEAT_ENV = 'PADDLE_HEARTBEAT_FILE'
 
-def _parse():
+
+def touch_heartbeat():
+    """Signal liveness to the launcher (no-op when not launched by it)."""
+    path = os.environ.get(HEARTBEAT_ENV)
+    if not path:
+        return
+    try:
+        with open(path, 'a'):
+            os.utime(path, None)
+    except OSError:
+        pass
+
+
+def _parse(argv=None):
     p = argparse.ArgumentParser('paddle_tpu.distributed.launch')
     p.add_argument('--nnodes', type=int,
                    default=int(os.environ.get('PADDLE_TRAINERS_NUM', '1')))
@@ -25,14 +45,57 @@ def _parse():
                    default=int(os.environ.get('PADDLE_TRAINER_ID', '0')))
     p.add_argument('--master', default=os.environ.get('PADDLE_MASTER', ''))
     p.add_argument('--max_restarts', type=int, default=0)
+    p.add_argument('--heartbeat_timeout', type=float, default=0.0,
+                   help='seconds of heartbeat-file staleness before the '
+                        'child is declared hung and restarted; 0 disables')
     p.add_argument('--log_dir', default=None)
     p.add_argument('training_script')
     p.add_argument('training_script_args', nargs=argparse.REMAINDER)
-    return p.parse_args()
+    return p.parse_args(argv)
 
 
-def main():
-    args = _parse()
+def _kill(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def _run_once(cmd, env, hb_path, hb_timeout):
+    """One child lifetime. Returns (exit_code | None, hung: bool)."""
+    if hb_path:
+        env = dict(env, **{HEARTBEAT_ENV: hb_path})
+        with open(hb_path, 'a'):
+            os.utime(hb_path, None)       # fresh epoch for this lifetime
+    proc = subprocess.Popen(cmd, env=env)
+
+    def _fwd(sig, frame):
+        proc.send_signal(sig)
+    signal.signal(signal.SIGTERM, _fwd)
+
+    if not (hb_path and hb_timeout > 0):
+        return proc.wait(), False
+    while True:
+        try:
+            return proc.wait(timeout=min(hb_timeout / 4.0, 5.0)), False
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            stale = time.time() - os.path.getmtime(hb_path)
+        except OSError:
+            stale = 0.0
+        if stale > hb_timeout:
+            print(f'[launch] heartbeat stale {stale:.0f}s '
+                  f'(> {hb_timeout:.0f}s): child presumed hung, killing',
+                  file=sys.stderr)
+            _kill(proc)
+            return None, True
+
+
+def main(argv=None):
+    args = _parse(argv)
     env = dict(os.environ)
     env['PADDLE_TRAINERS_NUM'] = str(args.nnodes)
     env['PADDLE_TRAINER_ID'] = str(args.node_rank)
@@ -40,23 +103,25 @@ def main():
         host, _, port = args.master.partition(':')
         env['PADDLE_MASTER'] = host
         env['MASTER_PORT'] = port or '8476'
+    hb_path = None
+    if args.heartbeat_timeout > 0:
+        base = args.log_dir or '/tmp'
+        os.makedirs(base, exist_ok=True)
+        hb_path = os.path.join(base, f'paddle_hb_{os.getpid()}')
 
     restarts = 0
     while True:
-        cmd = [sys.executable, args.training_script] + args.training_script_args
+        cmd = ([sys.executable, args.training_script]
+               + args.training_script_args)
         start = time.time()
-        proc = subprocess.Popen(cmd, env=env)
-
-        def _fwd(sig, frame):
-            proc.send_signal(sig)
-        signal.signal(signal.SIGTERM, _fwd)
-        code = proc.wait()
+        code, hung = _run_once(cmd, env, hb_path, args.heartbeat_timeout)
         if code == 0:
             return 0
         if restarts >= args.max_restarts:
-            sys.exit(code)
+            sys.exit(code if code is not None else 1)
         restarts += 1
-        print(f'[launch] child exited {code} after {time.time()-start:.0f}s; '
+        why = 'hung (heartbeat stale)' if hung else f'exited {code}'
+        print(f'[launch] child {why} after {time.time()-start:.0f}s; '
               f'restart {restarts}/{args.max_restarts}', file=sys.stderr)
 
 

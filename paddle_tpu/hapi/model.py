@@ -144,6 +144,8 @@ class Model:
 
     # ---- public batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        from ..distributed.launch import touch_heartbeat
+        touch_heartbeat()   # liveness signal for the elastic launcher
         if self._train_step is None:
             self._train_step = self._build_train_step()
             self._opt_state = self._optimizer.functional_init(self._params_dict())

@@ -1,0 +1,141 @@
+"""VERDICT r2 #8 + ADVICE r2: the multi-host path executes (2-process CPU
+mock of distributed.launch / jax.distributed.initialize), the launcher's
+liveness watchdog detects a HUNG child (not just a dead one), and the C++
+dataloader survives a many-worker stress run."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, tmp_path, name, extra_env=None, timeout=120):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(script))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu',
+               JAX_PLATFORM_NAME='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)   # no axon hook in children
+    env.update(extra_env or {})
+    return subprocess.run([sys.executable, str(path)], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_two_process_distributed_init(tmp_path):
+    """jax.distributed.initialize across 2 CPU processes through
+    init_parallel_env's env contract: both ranks see process_count()==2 and
+    2 global devices."""
+    script = """
+        import os, sys
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        from paddle_tpu.distributed.parallel import init_parallel_env
+        init_parallel_env()
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.device_count() == 2, jax.device_count()
+        assert jax.local_device_count() == 1
+        print(f'rank {jax.process_index()} OK', flush=True)
+    """
+    path = tmp_path / 'worker.py'
+    path.write_text(textwrap.dedent(script))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu',
+                   PADDLE_TRAINERS_NUM='2', PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_MASTER='127.0.0.1', MASTER_PORT='18476',
+                   XLA_FLAGS='')   # 1 cpu device per process
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        procs.append(subprocess.Popen([sys.executable, str(path)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=120) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-800:]
+    got = sorted(out.strip() for out, _ in outs)
+    assert got == ['rank 0 OK', 'rank 1 OK']
+
+
+def test_launcher_restarts_on_exit(tmp_path):
+    """Exit watch: a crashing child is restarted and can then succeed."""
+    marker = tmp_path / 'attempt'
+    script = f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, 'w').write(str(n + 1))
+        sys.exit(1 if n == 0 else 0)      # crash once, then succeed
+    """
+    worker = tmp_path / 'crashy.py'
+    worker.write_text(textwrap.dedent(script))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+         '--max_restarts', '2', str(worker)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert 'restart 1/2' in r.stderr
+    assert marker.read_text() == '2'
+
+
+def test_launcher_detects_hang(tmp_path):
+    """Liveness watch: a child that stops heartbeating (sleeps forever) is
+    killed and restarted; the second attempt heartbeats and succeeds."""
+    marker = tmp_path / 'attempt'
+    script = f"""
+        import os, sys, time
+        from paddle_tpu.distributed.launch import touch_heartbeat
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, 'w').write(str(n + 1))
+        if n == 0:
+            time.sleep(3600)              # hang: no heartbeat, no exit
+        for _ in range(3):
+            touch_heartbeat()
+            time.sleep(0.2)
+        sys.exit(0)
+    """
+    worker = tmp_path / 'hangy.py'
+    worker.write_text(textwrap.dedent(script))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+         '--max_restarts', '1', '--heartbeat_timeout', '3',
+         '--log_dir', str(tmp_path), str(worker)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert 'presumed hung' in r.stderr
+    assert marker.read_text() == '2'
+    assert time.time() - t0 < 60          # killed in ~timeout, not forever
+
+
+def test_dataloader_many_worker_stress():
+    """ADVICE r2: the C++ worker pool under real concurrency pressure —
+    8 workers, 3 epochs, order-insensitive exactly-once delivery."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    N = 512
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return (np.asarray([i], 'int64'),
+                    np.asarray([i * i % 1000], 'int64'))
+
+        def __len__(self):
+            return N
+
+    dl = DataLoader(DS(), batch_size=16, num_workers=8, shuffle=True)
+    for _epoch in range(3):
+        seen = []
+        for xb, yb in dl:
+            xs = xb.numpy().reshape(-1).tolist()
+            ys = yb.numpy().reshape(-1).tolist()
+            for x, y in zip(xs, ys):
+                assert y == x * x % 1000, (x, y)   # pairing intact
+            seen.extend(xs)
+        assert sorted(seen) == list(range(N))      # exactly once
