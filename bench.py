@@ -141,6 +141,30 @@ def _child_train(cfg):
     }))
 
 
+def _child_eager():
+    """Eager-dispatch overhead: small-tensor op chains through the dygraph
+    Tensor/tape layer (the reference's eager-mode benchmark dimension)."""
+    _arm_watchdog(180)
+    _force_cpu_if_requested()
+    import numpy as np
+    import paddle_tpu as paddle
+
+    a = paddle.to_tensor(np.random.rand(64, 64).astype('float32'))
+    b = paddle.to_tensor(np.random.rand(64, 64).astype('float32'))
+
+    def chain():
+        return (a.matmul(b) + a).multiply(b).sum()
+
+    chain().numpy()                      # warm caches
+    n = 300
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = chain()
+    _ = out.numpy()
+    dt = time.perf_counter() - t0
+    print(json.dumps({'eager_ops_per_sec': 4 * n / dt}))
+
+
 def _child_predictor():
     """p50 latency of a served vision model (ResNet-18, batch 1) through the
     full jit.save -> Predictor serving path, mirroring Paddle-Inference."""
@@ -302,6 +326,12 @@ def main():
     else:
         print(f'predictor bench failed: {pnote}', file=sys.stderr)
 
+    eager, enote = _run_child(['--child-eager'], 180)
+    if eager is not None:
+        out['eager_ops_per_sec'] = round(eager['eager_ops_per_sec'], 1)
+    else:
+        print(f'eager microbench failed: {enote}', file=sys.stderr)
+
     print(json.dumps(out))
     return 0
 
@@ -315,5 +345,7 @@ if __name__ == '__main__':
         _child_train(json.loads(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-predictor':
         _child_predictor()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-eager':
+        _child_eager()
     else:
         sys.exit(main())
