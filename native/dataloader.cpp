@@ -62,7 +62,11 @@ struct Pool {
     const int64_t n = static_cast<int64_t>(it->data.size());
     std::memcpy(out, it->data.data(), it->data.size());
     ready_.erase(it);
-    cv_space_.notify_one();
+    // next_out_ advanced: exactly one new seq entered the admission
+    // window, but notify_one could wake a worker whose seq is still
+    // outside it — that worker re-sleeps and the wakeup is lost, so the
+    // admissible worker never runs. Wake everyone.
+    cv_space_.notify_all();
     return n;
   }
 
@@ -107,8 +111,15 @@ struct Pool {
       }
       const int64_t n = fn_(index, scratch.data(), batch_bytes_, ctx_);
       std::unique_lock<std::mutex> g(mu_);
+      // Admission by CONSUMPTION WINDOW, not ring occupancy. Occupancy
+      // gating deadlocks: the consumer waits for seq `next_out_` while the
+      // ring sits full of later seqs and the worker holding `next_out_`
+      // waits for space the consumer will never free. Any seq inside
+      // [next_out_, next_out_ + ring_cap_) is admitted (the consumer
+      // drains in order, so at most ring_cap_ batches coexist); the batch
+      // the consumer is blocked on is always inside the window.
       cv_space_.wait(g, [&] {
-        return stopped_ || static_cast<int>(ready_.size()) < ring_cap_;
+        return stopped_ || index < next_out_ + ring_cap_;
       });
       if (stopped_) return;
       Batch b;
