@@ -65,10 +65,13 @@ _EPS = _np.float32(1e-30)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
-    # All scalar constants pinned to f32: under jax_enable_x64 a bare Python
-    # float becomes an f64 constant, which Mosaic cannot legalize on TPU.
+    # Scalar constants pinned to f32 (Mosaic rejects f64). MXU dtype policy:
+    # q/k/v stay in their NATIVE dtype for the dot_generals (bf16 inputs run
+    # the MXU at full rate) with f32 accumulation via preferred_element_type;
+    # the softmax scale is applied to the f32 scores AFTER the dot, so no
+    # precision is lost to a bf16 pre-scale.
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * _np.float32(scale)   # [BQ, D]
+    q = q_ref[0]                                            # [BQ, D] native
     s_total = k_ref.shape[1]
     nkb = s_total // bk
     d = q.shape[-1]
@@ -76,10 +79,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
     def body(kb, carry):
         # carries kept 2-D ([BQ,1]) — Mosaic vectorizes 2-D ops cleanly
         acc, m, l = carry
-        kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)   # [BK, D]
-        vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        kblk = k_ref[0, pl.ds(kb * bk, bk), :]                       # [BK, D]
+        vblk = v_ref[0, pl.ds(kb * bk, bk), :]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [BQ,BK]
+                                preferred_element_type=jnp.float32
+                                ) * _np.float32(scale)               # [BQ,BK]
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -88,8 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, bq, bk):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)                                   # [BQ,1]
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p cast to v's dtype: bf16×bf16→f32 keeps the MXU at full rate;
+        # identity for f32 inputs
         acc = acc * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -183,26 +189,28 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, dq_ref, *,
     pinned f32/i32 for Mosaic (see forward kernel notes).
     """
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * _np.float32(scale)      # [BQ, D]
-    g = g_ref[0].astype(jnp.float32)                           # [BQ, D]
+    q = q_ref[0]                                               # [BQ, D] native
+    g = g_ref[0]                                               # [BQ, D]
     lse = lse_ref[0][:, :1]                                    # [BQ, 1]
     delta = dta_ref[0][:, :1]                                  # [BQ, 1]
     nkb = k_ref.shape[1] // bk
     d = q.shape[-1]
 
     def body(kb, dq):
-        kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulation (see _fwd_kernel note)
+        kblk = k_ref[0, pl.ds(kb * bk, bk), :]
+        vblk = v_ref[0, pl.ds(kb * bk, bk), :]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32
+                                ) * _np.float32(scale)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        p = jnp.exp(s - lse)                                   # [BQ, BK] f32
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(kblk.dtype)
         dq = dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dq
@@ -218,30 +226,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref,
                     dk_ref, dv_ref, *, causal, scale, bq, bk):
     """dk/dv: each program owns one k/v block, streams q blocks."""
     ki = pl.program_id(1)
-    kblk = k_ref[0].astype(jnp.float32)                        # [BK, D]
-    vblk = v_ref[0].astype(jnp.float32)
+    kblk = k_ref[0]                                            # [BK, D] native
+    vblk = v_ref[0]
     nqb = q_ref.shape[1] // bq
     d = kblk.shape[-1]
 
     def body(qb, carry):
+        # native-dtype MXU operands, f32 accumulation (see _fwd_kernel
+        # note); softmax scale folded into the f32 score and the final dk
         dk, dv = carry
-        q = (q_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
-             * _np.float32(scale))                             # [BQ, D]
-        g = g_ref[0, pl.ds(qb * bq, bq), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * bq, bq), :]                    # [BQ, D]
+        g = g_ref[0, pl.ds(qb * bq, bq), :]
         lse = lse_ref[0, pl.ds(qb * bq, bq), :][:, :1]         # [BQ, 1]
         delta = dta_ref[0, pl.ds(qb * bq, bq), :][:, :1]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32
+                                ) * _np.float32(scale)
         if causal:
             q_pos = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                   # [BQ, BK]
-        dv = dv + jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+        p = jnp.exp(s - lse)                                   # [BQ, BK] f32
+        dv = dv + jax.lax.dot_general(p.astype(g.dtype), g,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -252,7 +263,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref,
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, jnp.asarray(nqb, jnp.int32), body,
                                (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * _np.float32(scale)).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
