@@ -72,6 +72,6 @@ def __getattr__(name):
     import importlib
     if name in ('distributed', 'vision', 'text', 'distribution', 'inference',
                 'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler',
-                'hub', 'onnx', 'parallel'):
+                'hub', 'onnx', 'parallel', 'fluid', 'dataset', 'reader'):
         return importlib.import_module(f'.{name}', __name__)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,14 @@
+"""Legacy ``paddle.dataset`` namespace: 1.x reader-generator access to the
+dataset zoo. Reference: python/paddle/dataset/ (mnist.py, cifar.py, ...,
+each exposing train()/test() -> generator functions).
+
+Thin adapters over the maintained map-style datasets in
+``paddle_tpu.vision.datasets`` / ``paddle_tpu.text.datasets``; samples come
+out in the reference's (flattened_image, label) tuple convention.
+"""
+from . import cifar  # noqa: F401
+from . import flowers  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401

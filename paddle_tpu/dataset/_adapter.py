@@ -1,0 +1,14 @@
+"""Map-style Dataset -> 1.x reader-generator adapter."""
+
+
+def reader_from(dataset_factory, transform=None):
+    """Returns a 1.x 'reader creator': calling it yields a fresh generator
+    over (sample...) tuples, re-instantiating the dataset lazily."""
+
+    def reader():
+        ds = dataset_factory()
+        for i in range(len(ds)):
+            item = ds[i]
+            yield transform(item) if transform is not None else tuple(item)
+
+    return reader

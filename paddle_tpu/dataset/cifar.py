@@ -1,0 +1,31 @@
+"""Reference: python/paddle/dataset/cifar.py (train10/test10/train100/
+test100 readers of (flattened rgb, label))."""
+import numpy as np
+
+from ._adapter import reader_from
+
+
+def _tf(item):
+    img, label = item
+    return (np.asarray(img, 'float32').reshape(-1) / 255.0,
+            int(np.asarray(label).reshape(())))
+
+
+def train10():
+    from ..vision.datasets import Cifar10
+    return reader_from(lambda: Cifar10(mode='train'), _tf)
+
+
+def test10():
+    from ..vision.datasets import Cifar10
+    return reader_from(lambda: Cifar10(mode='test'), _tf)
+
+
+def train100():
+    from ..vision.datasets import Cifar100
+    return reader_from(lambda: Cifar100(mode='train'), _tf)
+
+
+def test100():
+    from ..vision.datasets import Cifar100
+    return reader_from(lambda: Cifar100(mode='test'), _tf)

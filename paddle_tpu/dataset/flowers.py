@@ -1,0 +1,25 @@
+"""Reference: python/paddle/dataset/flowers.py."""
+import numpy as np
+
+from ._adapter import reader_from
+
+
+def _tf(item):
+    img, label = item
+    return (np.asarray(img, 'float32').reshape(-1) / 255.0,
+            int(np.asarray(label).reshape(()).astype('int64')))
+
+
+def train():
+    from ..vision.datasets import Flowers
+    return reader_from(lambda: Flowers(mode='train'), _tf)
+
+
+def test():
+    from ..vision.datasets import Flowers
+    return reader_from(lambda: Flowers(mode='test'), _tf)
+
+
+def valid():
+    from ..vision.datasets import Flowers
+    return reader_from(lambda: Flowers(mode='valid'), _tf)

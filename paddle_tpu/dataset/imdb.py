@@ -1,0 +1,23 @@
+"""Reference: python/paddle/dataset/imdb.py (word_dict + train/test readers
+of (token_ids, 0/1 label))."""
+from ._adapter import reader_from
+
+
+def word_dict():
+    from ..text.datasets import Imdb
+    return Imdb(mode='train').word_idx
+
+
+def _tf(item):
+    ids, label = item
+    return list(map(int, ids)), int(label)
+
+
+def train(word_idx=None):
+    from ..text.datasets import Imdb
+    return reader_from(lambda: Imdb(mode='train'), _tf)
+
+
+def test(word_idx=None):
+    from ..text.datasets import Imdb
+    return reader_from(lambda: Imdb(mode='test'), _tf)

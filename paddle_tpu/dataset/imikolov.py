@@ -1,0 +1,21 @@
+"""Reference: python/paddle/dataset/imikolov.py (PTB n-gram readers)."""
+from ._adapter import reader_from
+
+
+def build_dict(min_word_freq=50):
+    from ..text.datasets import Imikolov
+    return Imikolov(mode='train', data_type='NGRAM', window_size=2).word_idx
+
+
+def train(word_idx=None, n=5, data_type='NGRAM'):
+    from ..text.datasets import Imikolov
+    return reader_from(
+        lambda: Imikolov(mode='train', data_type=data_type, window_size=n),
+        lambda item: tuple(int(x) for x in item))
+
+
+def test(word_idx=None, n=5, data_type='NGRAM'):
+    from ..text.datasets import Imikolov
+    return reader_from(
+        lambda: Imikolov(mode='test', data_type=data_type, window_size=n),
+        lambda item: tuple(int(x) for x in item))

@@ -1,0 +1,21 @@
+"""Reference: python/paddle/dataset/mnist.py (train()/test() readers of
+(flattened normalized image, label))."""
+import numpy as np
+
+from ._adapter import reader_from
+
+
+def _tf(item):
+    img, label = item
+    return (np.asarray(img, 'float32').reshape(-1) / 255.0 * 2.0 - 1.0,
+            int(np.asarray(label).reshape(())))
+
+
+def train():
+    from ..vision.datasets import MNIST
+    return reader_from(lambda: MNIST(mode='train'), _tf)
+
+
+def test():
+    from ..vision.datasets import MNIST
+    return reader_from(lambda: MNIST(mode='test'), _tf)

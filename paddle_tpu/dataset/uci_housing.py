@@ -1,0 +1,20 @@
+"""Reference: python/paddle/dataset/uci_housing.py (normalized feature /
+price readers)."""
+import numpy as np
+
+from ._adapter import reader_from
+
+
+def _tf(item):
+    x, y = item
+    return np.asarray(x, 'float32'), np.asarray(y, 'float32')
+
+
+def train():
+    from ..text.datasets import UCIHousing
+    return reader_from(lambda: UCIHousing(mode='train'), _tf)
+
+
+def test():
+    from ..text.datasets import UCIHousing
+    return reader_from(lambda: UCIHousing(mode='test'), _tf)

@@ -1,0 +1,46 @@
+"""Legacy ``paddle.fluid`` namespace — alias shims for 1.x/2.0-era user
+programs. Reference: python/paddle/fluid/__init__.py (the pre-2.0 API the
+2.x surface re-exports from).
+
+Deliberately THIN: every symbol here aliases the maintained 2.x-style
+implementation elsewhere in paddle_tpu (static Program/Executor, nn layers,
+functional ops). Nothing is reimplemented; fluid-only concepts with no 2.x
+analogue (LoDTensor levels, DistributeTranspiler) are absent by design —
+see SURVEY §2 row 21 for the scope rationale.
+"""
+from ..core.tensor import Tensor  # noqa: F401
+from ..device import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, TPUPlace, XPUPlace,
+    is_compiled_with_cuda)
+from ..framework_io import load as load_dygraph  # noqa: F401
+from ..framework_io import save as save_dygraph  # noqa: F401
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static import (  # noqa: F401
+    BuildStrategy, CompiledProgram, Executor, ExecutionStrategy, Program,
+    Variable, data, default_main_program, default_startup_program,
+    global_scope, name_scope, program_guard, scope_guard)
+from ..utils.misc import (  # noqa: F401
+    disable_static as disable_dygraph, enable_static as enable_dygraph,
+    in_dynamic_mode as in_dygraph_mode)
+from . import dygraph  # noqa: F401
+from . import initializer  # noqa: F401
+from . import io  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+
+# fluid.core compatibility alias (user code probes paddle.fluid.core.*)
+from ..device import is_compiled_with_cuda as _is_cuda
+
+
+class core:
+    """Shim for the C++ binding module user code introspects."""
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return _is_cuda()
+
+    @staticmethod
+    def get_cuda_device_count():
+        return 0

@@ -1,0 +1,32 @@
+"""``paddle.fluid.dygraph`` aliases -> 2.x nn/eager API.
+Reference: python/paddle/fluid/dygraph/ (layers.py, base.py, nn.py)."""
+import contextlib
+
+from ..core.tensor import Tensor, no_grad_ctx as no_grad  # noqa: F401
+from ..core.tensor import to_tensor as to_variable  # noqa: F401
+from ..nn import (  # noqa: F401
+    AvgPool2D, BatchNorm1D, BatchNorm2D, Conv2D, Dropout, Embedding,
+    LayerNorm, Linear, MaxPool2D)
+
+BatchNorm = BatchNorm2D     # 1.x name
+from ..nn.layer_base import Layer  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """1.x dygraph guard — eager is the default here; pure pass-through."""
+    yield
+
+
+def save_dygraph(state_dict, model_path):
+    from ..framework_io import save
+    save(state_dict, model_path + '.pdparams')
+
+
+def load_dygraph(model_path):
+    from ..framework_io import load
+    import os
+    path = model_path if model_path.endswith('.pdparams') \
+        else model_path + '.pdparams'
+    state = load(path)
+    return state, None       # (param_state, optimizer_state) tuple in 1.x

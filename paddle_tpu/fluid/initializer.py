@@ -1,0 +1,13 @@
+"""``paddle.fluid.initializer`` aliases.
+Reference: python/paddle/fluid/initializer.py."""
+from ..nn.initializer import (  # noqa: F401
+    Assign, Constant, KaimingNormal, KaimingUniform, Normal,
+    TruncatedNormal, Uniform, XavierNormal, XavierUniform)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

@@ -1,0 +1,18 @@
+"""``paddle.fluid.io`` aliases -> jit.save/load + io datasets.
+Reference: python/paddle/fluid/io.py."""
+from ..io import DataLoader  # noqa: F401
+
+
+def save_inference_model(dirname, feeded_var_names=None, target_vars=None,
+                         executor=None, main_program=None, **kw):
+    raise NotImplementedError(
+        'fluid.io.save_inference_model serialized ProgramDesc graphs; use '
+        'paddle.jit.save(layer, path, input_spec=[...]) which exports the '
+        'StableHLO standalone program (.pdexec) served by '
+        'paddle.inference.create_predictor.')
+
+
+def load_inference_model(dirname, executor=None, **kw):
+    raise NotImplementedError(
+        'use paddle.jit.load(path) or paddle.inference.create_predictor('
+        'Config(path + ".pdmodel")).')

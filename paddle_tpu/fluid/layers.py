@@ -1,0 +1,98 @@
+"""``paddle.fluid.layers`` aliases -> 2.x functional/tensor ops.
+Reference: python/paddle/fluid/layers/ (nn.py, tensor.py, control_flow.py).
+Functional-style op names map one-to-one onto the maintained
+``paddle_tpu.nn.functional`` / ``paddle_tpu.tensor`` implementations.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn import functional as F
+from ..static import data  # noqa: F401
+from ..tensor import (  # noqa: F401
+    abs, arange, argmax, argmin, argsort, cast, clip, concat, cos, cumsum,
+    exp, expand, eye, flatten, floor, full, gather, linspace, log, matmul,
+    maximum, mean, minimum, ones, ones_like, pow,
+    reshape, scale, shape, sin, slice, split, sqrt, square, squeeze, stack,
+    sum, tanh, tile, topk, transpose, unsqueeze, where, zeros, zeros_like)
+
+# activation / nn functional aliases
+relu = F.relu
+sigmoid = F.sigmoid
+softmax = F.softmax
+log_softmax = F.log_softmax
+leaky_relu = F.leaky_relu
+elu = F.elu
+gelu = F.gelu
+hard_sigmoid = F.hardsigmoid
+softplus = F.softplus
+dropout = F.dropout
+cross_entropy = F.cross_entropy
+one_hot = F.one_hot
+embedding = F.embedding
+conv2d = F.conv2d
+pool2d = None  # assigned below (mode switch)
+batch_norm = F.batch_norm
+layer_norm = F.layer_norm
+pad = F.pad
+softmax_with_cross_entropy = F.softmax_with_cross_entropy
+sigmoid_cross_entropy_with_logits = \
+    F.binary_cross_entropy_with_logits
+reduce_mean = mean
+reduce_sum = sum
+reduce_max = None
+elementwise_add = None
+elementwise_sub = None
+elementwise_mul = None
+elementwise_div = None
+
+
+def _binary(fn):
+    def op(x, y, axis=-1, act=None, name=None):
+        out = fn(x, y)
+        if act is not None:
+            out = getattr(F, act)(out)
+        return out
+    return op
+
+
+elementwise_add = _binary(lambda x, y: x + y)
+elementwise_sub = _binary(lambda x, y: x - y)
+elementwise_mul = _binary(lambda x, y: x * y)
+elementwise_div = _binary(lambda x, y: x / y)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    from ..tensor import max as _max
+    return _max(input, axis=dim, keepdim=keep_dim)
+
+
+def pool2d(input, pool_size=2, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    if global_pooling:
+        from ..nn.functional import adaptive_avg_pool2d, adaptive_max_pool2d
+        return (adaptive_max_pool2d(input, 1) if pool_type == 'max'
+                else adaptive_avg_pool2d(input, 1))
+    fn = F.max_pool2d if pool_type == 'max' else F.avg_pool2d
+    return fn(input, kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid.layers.fc: eager functional linear with on-the-fly params is a
+    1.x static-graph idiom; in this stack use paddle.nn.Linear. Kept to give
+    a precise migration error rather than AttributeError."""
+    raise NotImplementedError(
+        'fluid.layers.fc built static-graph variables; use '
+        'paddle.nn.Linear(in_features, size) (see paddle 2.x migration '
+        'guide) — layer objects work in both eager and to_static modes.')
+
+
+def assign(input, output=None):
+    t = input if isinstance(input, Tensor) else to_tensor(input)
+    return Tensor(jnp.asarray(t._value))
+
+
+def fill_constant(shape, dtype, value, name=None):
+    from ..tensor import full as _full
+    return _full(shape, value, dtype=dtype)

@@ -1,0 +1,15 @@
+"""``paddle.fluid.optimizer`` aliases (XxxOptimizer naming).
+Reference: python/paddle/fluid/optimizer.py."""
+from ..optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    RMSProp, SGD)
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdagradOptimizer = Adagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum

@@ -1,0 +1,115 @@
+"""Legacy 1.x namespaces (paddle.fluid / paddle.dataset / paddle.reader) —
+thin aliases over the 2.x implementations, exercised end to end.
+Reference: python/paddle/fluid/, python/paddle/dataset/, python/paddle/reader/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_fluid_layers_ops():
+    fluid = paddle.fluid
+    x = paddle.to_tensor(np.array([[-1.0, 2.0]], 'float32'))
+    np.testing.assert_allclose(fluid.layers.relu(x).numpy(), [[0.0, 2.0]])
+    np.testing.assert_allclose(
+        fluid.layers.elementwise_add(x, x).numpy(), [[-2.0, 4.0]])
+    np.testing.assert_allclose(
+        fluid.layers.fill_constant([3], 'float32', 2.5).numpy(),
+        [2.5, 2.5, 2.5])
+    np.testing.assert_allclose(
+        fluid.layers.reduce_mean(x).numpy(), 0.5)
+    out = fluid.layers.pool2d(
+        paddle.to_tensor(np.ones((1, 1, 4, 4), 'float32')), 2, 'avg', 2)
+    assert list(out.shape) == [1, 1, 2, 2]
+    with pytest.raises(NotImplementedError):
+        fluid.layers.fc(x, 4)      # static-graph idiom: precise message
+
+
+def test_fluid_dygraph_trains():
+    fluid = paddle.fluid
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(4, 2)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameters=net.parameters())
+        x = fluid.dygraph.to_variable(np.ones((8, 4), 'float32'))
+        before = np.asarray(net.weight.numpy()).copy()
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert not np.allclose(net.weight.numpy(), before)
+
+
+def test_fluid_static_program_executor():
+    fluid = paddle.fluid
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.data('x', [None, 2], 'float32')
+            y = paddle.fluid.layers.relu(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(main, feed={'x': np.array([[-1.0, 3.0]], 'float32')},
+                         fetch_list=[y])
+        np.testing.assert_allclose(out, [[0.0, 3.0]])
+    finally:
+        paddle.disable_static()
+
+
+def test_dataset_readers():
+    r = paddle.dataset.mnist.train()
+    first = next(iter(r()))
+    assert first[0].shape == (784,) and first[0].dtype == np.float32
+    assert -1.0 <= float(first[0].min()) and float(first[0].max()) <= 1.0
+    assert isinstance(first[1], int)
+
+    r10 = paddle.dataset.cifar.train10()
+    img, label = next(iter(r10()))
+    assert img.shape == (3072,) and 0 <= label < 10
+
+    uci = paddle.dataset.uci_housing.train()
+    x, y = next(iter(uci()))
+    assert x.shape[-1] == 13
+
+
+def test_reader_combinators():
+    def nums():
+        return iter(range(10))
+
+    sq = paddle.reader.map_readers(lambda a: a * a, nums)
+    assert list(sq()) == [i * i for i in range(10)]
+
+    sh = paddle.reader.shuffle(nums, 5)
+    assert sorted(sh()) == list(range(10))
+
+    ch = paddle.reader.chain(nums, nums)
+    assert len(list(ch())) == 20
+
+    comp = paddle.reader.compose(nums, sq)
+    assert list(comp())[:3] == [(0, 0), (1, 1), (2, 4)]
+
+    short = lambda: iter(range(3))
+    bad = paddle.reader.compose(nums, short)
+    with pytest.raises(ValueError):
+        list(bad())
+
+    buf = paddle.reader.buffered(nums, 4)
+    assert list(buf()) == list(range(10))
+
+    fn = paddle.reader.firstn(nums, 3)
+    assert list(fn()) == [0, 1, 2]
+
+    calls = []
+
+    def tracked():
+        calls.append(1)
+        return iter(range(4))
+
+    cached = paddle.reader.cache(tracked)
+    assert list(cached()) == list(cached()) == [0, 1, 2, 3]
+    assert len(calls) == 1
+
+    xm = paddle.reader.xmap_readers(lambda a: a + 1, nums, 4, 8, order=True)
+    assert list(xm()) == list(range(1, 11))
+    xmu = paddle.reader.xmap_readers(lambda a: a + 1, nums, 4, 8, order=False)
+    assert sorted(xmu()) == list(range(1, 11))
