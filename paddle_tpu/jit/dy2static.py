@@ -22,6 +22,7 @@ import types
 import warnings
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -147,6 +148,84 @@ def convert_while(cond_fn, body_fn, names, init_vals):
             f'the same shape/dtype every iteration; ({e})') from e
     return tuple(Tensor(o) if isinstance(o, (jax.Array, jax.core.Tracer))
                  else o for o in outs)
+
+
+def convert_for_range(start, stop, step, body_fn, names, init_vals,
+                      tgt_init=UNDEF):
+    """``for <t> in range(...)``: lax.while_loop when any bound traces,
+    exact Python iteration otherwise. Returns (final_target, *final_vars);
+    ``tgt_init`` is the target's pre-loop value so a zero-trip loop leaves
+    it untouched (or unbound), exactly like Python.
+
+    Traced caveats (documented): after a zero-trip traced loop the target
+    holds ``start - step`` rather than being unbound — data-dependent trip
+    counts cannot leave a variable undefined in a static graph — and a
+    traced ``step == 0`` yields zero iterations instead of Python's
+    ``ValueError`` (a compiled graph cannot raise data-dependently).
+    """
+    traced = any(_is_traced(v) for v in (start, stop, step))
+    if not traced:
+        t = tgt_init
+        vals = tuple(init_vals)
+        for i in range(int(_unwrap(start)), int(_unwrap(stop)),
+                       int(_unwrap(step))):
+            t = i
+            vals = tuple(body_fn(i, *vals))
+        return (t,) + vals
+
+    _check_bound(names, init_vals, 'for')
+    u_start = jnp.asarray(_unwrap(start))
+    u_stop = jnp.asarray(_unwrap(stop))
+    u_step = jnp.asarray(_unwrap(step))
+
+    def rewrap(u_vals):
+        return tuple(Tensor(u) if isinstance(orig, Tensor) else u
+                     for orig, u in zip(init_vals, u_vals))
+
+    def u_cond(carry):
+        i, _ = carry
+        # step==0 must terminate (zero-trip), not spin forever
+        return jnp.where(u_step > 0, i < u_stop,
+                         (u_step < 0) & (i > u_stop))
+
+    def u_body(carry):
+        i, u_vals = carry
+        outs = body_fn(Tensor(i), *rewrap(u_vals))
+        _check_bound(names, outs, 'for')
+        return i + u_step, tuple(_unwrap(o) for o in outs)
+
+    try:
+        i_fin, outs = jax.lax.while_loop(
+            u_cond, u_body, (u_start, tuple(_unwrap(v) for v in init_vals)))
+    except TypeError as e:
+        raise Dy2StaticError(
+            f'loop variables {names} of a tensor-range for must keep the '
+            f'same shape/dtype every iteration; ({e})') from e
+    return (Tensor(i_fin - u_step),) + tuple(
+        Tensor(o) if isinstance(o, (jax.Array, jax.core.Tracer)) else o
+        for o in outs)
+
+
+def logical_and(lhs, rhs_thunk):
+    """``a and b``. Traced lhs: jnp.logical_and (both sides evaluated —
+    pure under trace). Plain lhs: exact Python semantics — short-circuit,
+    operand (not bool) returned; a traced rhs simply passes through, which
+    is what Python's `and` does too."""
+    if _is_traced(lhs):
+        return Tensor(jnp.logical_and(_unwrap(lhs), _unwrap(rhs_thunk())))
+    return rhs_thunk() if _to_py_bool(lhs) else lhs
+
+
+def logical_or(lhs, rhs_thunk):
+    if _is_traced(lhs):
+        return Tensor(jnp.logical_or(_unwrap(lhs), _unwrap(rhs_thunk())))
+    return lhs if _to_py_bool(lhs) else rhs_thunk()
+
+
+def logical_not(x):
+    if _is_traced(x):
+        return Tensor(jnp.logical_not(_unwrap(x)))
+    return not _to_py_bool(x)
 
 
 def unsupported_guard(pred, reason):
@@ -328,6 +407,38 @@ def _undef_dels(mods):
     return out
 
 
+def _rewrite_boolops(expr):
+    """Rewrite `a and b` / `a or b` / `not a` in a condition into the
+    runtime logical converters (reference: convert_operators.convert_logical_
+    and/or/not) — right operands wrapped in lambdas so Python-path
+    short-circuiting is preserved exactly."""
+
+    class BoolRw(ast.NodeTransformer):
+        def visit_BoolOp(self, node):
+            self.generic_visit(node)
+            attr = ('logical_and' if isinstance(node.op, ast.And)
+                    else 'logical_or')
+            out = node.values[0]
+            for rhs in node.values[1:]:
+                thunk = ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=rhs)
+                out = _rt_call(attr, [out, thunk])
+            return out
+
+        def visit_UnaryOp(self, node):
+            self.generic_visit(node)
+            if isinstance(node.op, ast.Not):
+                return _rt_call('logical_not', [node.operand])
+            return node
+
+        def visit_Lambda(self, node):    # don't descend into inner scopes
+            return node
+
+    return BoolRw().visit(expr)
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._uid = 0
@@ -339,6 +450,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     # -- if/else ---------------------------------------------------------
     def visit_If(self, node):
         self.generic_visit(node)
+        node.test = _rewrite_boolops(node.test)
         mods = _mods_of(node.body, node.orelse)
         if mods is None or not mods:
             # not convertible (or pure side-effect): keep Python `if`, but
@@ -371,6 +483,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     # -- while -----------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
+        node.test = _rewrite_boolops(node.test)
         mods = _mods_of(node.body)
         if mods is None or not mods or node.orelse:
             reason = ('while has an else clause' if node.orelse else
@@ -400,13 +513,74 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [cond_fn, _func_def(bname, mods, node.body, mods),
                 *sent, call, *_undef_dels(mods)]
 
+    # -- for i in range(...) --------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if not _is_range_for(node):
+            # non-range iterables unroll under tracing (plain Python) —
+            # leave untouched
+            return node
+        mods = _mods_of(node.body)
+        if not isinstance(node.target, ast.Name) or node.orelse \
+                or mods is None or not mods:
+            # not convertible: keep Python semantics, but a TRACED bound
+            # gets an actionable error instead of jax's concretization one
+            reason = ('for has an else clause' if node.orelse else
+                      'loop target is not a simple name'
+                      if not isinstance(node.target, ast.Name) else
+                      'body contains return/break/continue or attribute/'
+                      'subscript stores' if mods is None
+                      else 'body rebinds no local variables')
+            node.iter.args = [
+                _rt_call('unsupported_guard', [a, ast.Constant(value=reason)])
+                for a in node.iter.args]
+            return node
+        uid = self._next()
+        a = node.iter.args
+        if len(a) == 1:
+            start, stop, step = ast.Constant(value=0), a[0], \
+                ast.Constant(value=1)
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], ast.Constant(value=1)
+        else:
+            start, stop, step = a
+        tgt = node.target.id
+        bname = f'{_GEN_PREFIX}rb_{uid}'
+        body_fn = _func_def(bname, [tgt] + mods, node.body, mods)
+        # sentinel-read the target too: a zero-trip Python loop must leave
+        # a pre-existing binding untouched (and an absent one absent)
+        sent, tmp_names = _sentinel_reads(mods + [tgt], uid)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(tgt)] + [_store(m) for m in mods],
+                               ctx=ast.Store())],
+            value=_rt_call('convert_for_range', [
+                start, stop, step, _load(bname), _names_tuple(mods),
+                ast.Tuple(elts=[_load(t) for t in tmp_names[:-1]],
+                          ctx=ast.Load()),
+                _load(tmp_names[-1])]))
+        return [body_fn, *sent, call, *_undef_dels([tgt] + mods)]
+
 
 # --------------------------------------------------------------------------
 # entry point
 # --------------------------------------------------------------------------
 
+def _is_range_for(node):
+    return (isinstance(node, ast.For)
+            and isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == 'range'
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3)
+
+
 def _has_control_flow(tree):
-    return any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(tree))
+    """Only rewrite functions we might actually convert: if/while, or a
+    range() for. A function with only plain-iterable fors is returned
+    untouched — re-exec'ing it would needlessly snapshot its closure and
+    strip stacked decorators."""
+    return any(isinstance(n, (ast.If, ast.While)) or _is_range_for(n)
+               for n in ast.walk(tree))
 
 
 def convert_control_flow(fn):
@@ -473,4 +647,8 @@ class _runtime_namespace:
     UNDEF = UNDEF
     convert_ifelse = staticmethod(convert_ifelse)
     convert_while = staticmethod(convert_while)
+    convert_for_range = staticmethod(convert_for_range)
+    logical_and = staticmethod(logical_and)
+    logical_or = staticmethod(logical_or)
+    logical_not = staticmethod(logical_not)
     unsupported_guard = staticmethod(unsupported_guard)

@@ -261,3 +261,164 @@ def test_python_container_truthiness():
     g = convert_control_flow(f)
     np.testing.assert_allclose(g(_t([1.0]), [1, 2]).numpy(), [2.0])
     np.testing.assert_allclose(g(_t([1.0]), []).numpy(), [1.0])
+
+
+def test_tensor_for_range():
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = x * 0
+        for i in range(n):
+            acc = acc + x * (i + 1)
+        return acc
+
+    n = paddle.to_tensor(np.asarray(4, dtype='int32'))
+    # 1+2+3+4 = 10
+    np.testing.assert_allclose(f(_t([1.0, 2.0]), n).numpy(), [10.0, 20.0])
+
+
+def test_tensor_for_range_start_step():
+    @paddle.jit.to_static
+    def f(x, lo, hi):
+        acc = x * 0
+        for i in range(lo, hi, 2):
+            acc = acc + i
+        return acc
+
+    lo = paddle.to_tensor(np.asarray(1, dtype='int32'))
+    hi = paddle.to_tensor(np.asarray(8, dtype='int32'))
+    # 1+3+5+7 = 16
+    np.testing.assert_allclose(f(_t([0.0]), lo, hi).numpy(), [16.0])
+
+
+def test_python_for_range_semantics_preserved():
+    def f(x, n):
+        acc = x * 0
+        for i in range(n):
+            acc = acc + x
+        return acc, i   # python leaves target at last value  # noqa: F821
+
+    g = convert_control_flow(f)
+    acc, i = g(_t([1.0]), 3)
+    np.testing.assert_allclose(acc.numpy(), [3.0])
+    assert i == 2
+    with pytest.raises((UnboundLocalError, NameError)):
+        g(_t([1.0]), 0)        # zero-trip: target stays unbound
+
+
+def test_bool_ops_in_tensor_conditions():
+    @paddle.jit.to_static
+    def f(x):
+        if (x.mean() > 0) and (x.sum() < 10):
+            y = x + 100
+        else:
+            y = x - 100
+        return y
+
+    np.testing.assert_allclose(f(_t([1.0, 2.0])).numpy(), [101.0, 102.0])
+    np.testing.assert_allclose(f(_t([6.0, 6.0])).numpy(), [-94.0, -94.0])
+    np.testing.assert_allclose(f(_t([-1.0, -1.0])).numpy(), [-101.0, -101.0])
+
+    @paddle.jit.to_static
+    def g(x):
+        if not (x.mean() > 0):
+            y = x * 0
+        else:
+            y = x
+        return y
+
+    np.testing.assert_allclose(g(_t([2.0])).numpy(), [2.0])
+    np.testing.assert_allclose(g(_t([-2.0])).numpy(), [0.0])
+
+
+def test_bool_ops_short_circuit_python_lhs():
+    """`flag and <tensor cond>` with flag=False must short-circuit and
+    never evaluate the tensor side (exact Python semantics)."""
+    calls = []
+
+    def f(x, flag):
+        def probe():
+            calls.append(1)
+            return x.mean() > 0
+        if flag and probe():
+            y = x + 1
+        else:
+            y = x
+        return y
+
+    g = convert_control_flow(f)
+    np.testing.assert_allclose(g(_t([1.0]), False).numpy(), [1.0])
+    assert calls == []             # rhs never evaluated
+    np.testing.assert_allclose(g(_t([1.0]), True).numpy(), [2.0])
+    assert calls == [1]
+
+
+def test_while_with_or_condition():
+    @paddle.jit.to_static
+    def f(x):
+        s = x * 0
+        n = x.sum() * 0
+        while (s.sum() < 6) or (n < 2):
+            s = s + x
+            n = n + 1
+        return s, n
+
+    s, n = f(_t([1.0, 1.0]))
+    np.testing.assert_allclose(s.numpy(), [3.0, 3.0])   # stops at sum=6,n=3
+    assert float(n.numpy()) == 3.0
+
+
+def test_zero_trip_for_keeps_prior_target_binding():
+    """Python: `i = 99; for i in range(0): ...` leaves i == 99."""
+    def f(x, n):
+        i = 99
+        for i in range(n):
+            x = x + 1
+        return x, i
+
+    g = convert_control_flow(f)
+    x, i = g(_t([1.0]), 0)
+    assert i == 99
+    x, i = g(_t([1.0]), 2)
+    assert i == 1
+
+
+def test_nonconvertible_traced_for_errors_clearly():
+    """break in a tensor-range for: actionable Dy2StaticError, not jax's
+    concretization error."""
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = x * 0
+        for i in range(n):
+            if int(0) == 0:
+                break
+            acc = acc + x
+        return acc
+
+    n = paddle.to_tensor(np.asarray(3, dtype='int32'))
+    with pytest.raises(Dy2StaticError) as ei:
+        f(_t([1.0]), n)
+    assert 'break' in str(ei.value) or 'not convertible' in str(ei.value)
+
+
+def test_plain_iterable_for_not_reexeced():
+    """A function whose only loop iterates a plain list must be returned
+    unchanged (no closure snapshot / decorator stripping)."""
+    def f(x):
+        for v in [1, 2, 3]:
+            x = x + v
+        return x
+
+    assert convert_control_flow(f) is f
+
+
+def test_traced_step_zero_terminates():
+    @paddle.jit.to_static
+    def f(x, s):
+        acc = x * 0
+        for i in range(0, 4, s):
+            acc = acc + 1
+        return acc
+
+    s0 = paddle.to_tensor(np.asarray(0, dtype='int32'))
+    # zero-trip, not an infinite compiled loop
+    np.testing.assert_allclose(f(_t([1.0]), s0).numpy(), [0.0])
