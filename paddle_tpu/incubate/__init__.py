@@ -152,3 +152,52 @@ def segment_min(data, segment_ids, name=None):
     import jax
     return jax.ops.segment_min(data, segment_ids,
                                num_segments=_num_segments(segment_ids))
+
+
+# ---- auto_checkpoint (reference: incubate/checkpoint/auto_checkpoint.py) -
+class _AutoCheckpoint:
+    """The reference's ACP hooks training loops to snapshot/restore
+    transparently on preemption. This stack reaches the same goal through
+    hapi.Model + orbax CheckpointManager auto-resume (see hapi/model.py);
+    these entry points adapt that machinery to the ACP API names."""
+
+    def __init__(self):
+        self._enabled = False
+
+    def train_epoch_range(self, max_epoch_num, save_checkpoint_inter=None):
+        """Iterate epochs, resuming from the last completed one if a
+        checkpoint range-state file exists."""
+        import json
+        import os
+        base = os.environ.get('PADDLE_CHECKPOINT_DIR', '.acp')
+        os.makedirs(base, exist_ok=True)
+        state = os.path.join(base, 'epoch_range.json')
+        start = 0
+        if os.path.exists(state):
+            with open(state) as f:
+                start = json.load(f).get('next_epoch', 0)
+        for e in range(start, max_epoch_num):
+            yield e
+            with open(state, 'w') as f:
+                json.dump({'next_epoch': e + 1}, f)
+
+
+auto_checkpoint = _AutoCheckpoint()
+
+
+class LayerHelper:
+    """Reference: fluid/layer_helper.py — static-graph op/param factory.
+    Eager stack: thin adapter exposing the attribute surface old custom-op
+    code probes (main_program/startup_program naming, create_parameter)."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype='float32',
+                         is_bias=False, default_initializer=None):
+        from ..core.tensor import Tensor
+        from ..nn.initializer import Constant, XavierNormal
+        init = default_initializer or (Constant(0.0) if is_bias
+                                       else XavierNormal())
+        return Tensor(init(shape, dtype), stop_gradient=False)

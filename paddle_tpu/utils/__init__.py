@@ -10,6 +10,22 @@ from . import unique_name  # noqa: F401
 from . import download  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from . import image_util  # noqa: F401
+
+# the reference vendors the `gast` AST-portability library for its
+# dy2static transformers; this stack's transformer (jit/dy2static.py)
+# targets one fixed CPython, so stdlib `ast` plays that role
+import ast as gast  # noqa: F401
+
+
+class OpLastCheckpointChecker:
+    """Reference: utils/op_version.py — queries the C++ operator registry
+    for version-upgrade notes. There is no ProgramDesc op registry here
+    (XLA HLO is the IR), so every query reports 'no updates', which is the
+    reference's own answer for up-to-date operators."""
+
+    def filter_updates(self, op_name, type=None, key=''):
+        return []
 from .deprecated import deprecated  # noqa: F401
 from .install_check import run_check  # noqa: F401
 from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401

@@ -1,0 +1,68 @@
+"""paddle.utils.image_util: classic image preprocessing helpers.
+Reference: python/paddle/utils/image_util.py (resize_short / crop /
+flip helpers used by 1.x example pipelines). numpy/PIL implementations
+with the same semantics; HWC uint8/float arrays in and out.
+"""
+import numpy as np
+
+__all__ = ['resize_short', 'center_crop', 'random_crop', 'left_right_flip',
+           'simple_transform']
+
+
+def _to_pil(im):
+    from PIL import Image
+    if isinstance(im, Image.Image):
+        return im
+    arr = np.asarray(im)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype('uint8')
+    return Image.fromarray(arr)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (aspect preserved)."""
+    pil = _to_pil(im)
+    w, h = pil.size
+    if w < h:
+        nw, nh = size, max(1, round(h * size / w))
+    else:
+        nw, nh = max(1, round(w * size / h)), size
+    return np.asarray(pil.resize((nw, nh)))
+
+
+def center_crop(im, size):
+    arr = np.asarray(im)
+    h, w = arr.shape[:2]
+    top = max((h - size) // 2, 0)
+    left = max((w - size) // 2, 0)
+    return arr[top:top + size, left:left + size]
+
+
+def random_crop(im, size, rng=None):
+    rng = rng or np.random
+    arr = np.asarray(im)
+    h, w = arr.shape[:2]
+    top = rng.randint(0, max(h - size, 0) + 1)
+    left = rng.randint(0, max(w - size, 0) + 1)
+    return arr[top:top + size, left:left + size]
+
+
+def left_right_flip(im):
+    return np.asarray(im)[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, mean=None,
+                     scale=1.0):
+    """resize_short -> (random|center) crop -> maybe flip -> CHW float."""
+    im = resize_short(im, resize_size)
+    im = random_crop(im, crop_size) if is_train else center_crop(im, crop_size)
+    if is_train and np.random.rand() < 0.5:
+        im = left_right_flip(im)
+    out = np.asarray(im, 'float32') * scale
+    if out.ndim == 3:
+        out = out.transpose(2, 0, 1)        # HWC -> CHW
+    if mean is not None:
+        mean = np.asarray(mean, 'float32')
+        out = out - (mean.reshape(-1, 1, 1) if mean.ndim == 1 and
+                     out.ndim == 3 else mean)
+    return out

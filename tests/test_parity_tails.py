@@ -176,3 +176,35 @@ def test_fleet_data_generator():
 
     lines = G().run_from_memory(['1 2 3', '4 5'])
     assert lines == ['3 1 2 3 1 1\n', '2 4 5 1 1\n']
+
+
+def test_utils_image_util():
+    iu = paddle.utils.image_util
+    im = (np.random.RandomState(0).rand(40, 60, 3) * 255).astype('uint8')
+    r = iu.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[0] == 32   # short side = H
+    c = iu.center_crop(r, 24)
+    assert c.shape[:2] == (24, 24)
+    f = iu.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    t = iu.simple_transform(im, 36, 32, is_train=False,
+                            mean=[127.0, 127.0, 127.0])
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+
+
+def test_utils_gast_and_op_checker():
+    assert paddle.utils.gast.parse('x = 1')            # stdlib ast role
+    checker = paddle.utils.OpLastCheckpointChecker()
+    assert checker.filter_updates('matmul') == []
+
+
+def test_incubate_auto_checkpoint_and_layer_helper(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_CHECKPOINT_DIR', str(tmp_path))
+    acp = paddle.incubate.auto_checkpoint
+    assert list(acp.train_epoch_range(2)) == [0, 1]
+    assert list(acp.train_epoch_range(4)) == [2, 3]    # resumed
+    h = paddle.incubate.LayerHelper('fc')
+    w = h.create_parameter(shape=[4, 2])
+    b = h.create_parameter(shape=[2], is_bias=True)
+    assert list(w.shape) == [4, 2] and not w.stop_gradient
+    assert float(np.abs(np.asarray(b.numpy())).sum()) == 0.0
