@@ -51,6 +51,7 @@ from . import sysconfig  # noqa: F401
 from .compat_api import *  # noqa: F401,F403
 from .compat_api import dtype, VarBase, t  # noqa: F401
 from .version import full_version, commit  # noqa: F401
+__git_commit__ = commit
 from . import version  # noqa: F401
 from . import callbacks as callbacks_mod  # noqa: F401
 from .device import (  # noqa: F401

@@ -250,3 +250,40 @@ class UtilBase:
 
 
 util = UtilBase()
+
+
+class Fleet:
+    """Reference: fleet/base/fleet_base.py Fleet — the stateful facade the
+    module-level functions delegate to. Instantiable for API parity; all
+    methods operate on the module-level topology state."""
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        barrier_worker()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    @property
+    def util(self):
+        return util

@@ -190,8 +190,54 @@ def create_predictor(config):
     return Predictor(config)
 
 
-def convert_to_mixed_precision(*args, **kwargs):
-    raise NotImplementedError('planned (round 2)')
+def convert_to_mixed_precision(model_file, params_file=None,
+                               save_model_path=None, save_params_path=None,
+                               mixed_precision='bfloat16', backend=None,
+                               black_list=None, **kwargs):
+    """Offline-convert a jit.save'd model's weights to a mixed-precision
+    copy (reference: paddle.inference.convert_to_mixed_precision rewriting
+    the ProgramDesc). TPU-native: floating params are cast to the target
+    dtype (bf16 is the TPU-native choice) and re-saved under the new
+    prefix; the serialized fp32 program is NOT carried over (its dtypes are
+    pinned), so the converted model serves through attach_layer(), where
+    the Predictor re-jits at the stored precision.
+
+    ``model_file``: path to the source '.pdmodel' (or its prefix);
+    ``save_model_path``: destination prefix (or '.pdmodel' path).
+    """
+    import json
+
+    from ..framework_io import save as fsave
+    from ..jit import load_saved_artifacts
+
+    def _prefix(p):
+        return p[:-len('.pdmodel')] if p.endswith('.pdmodel') else p
+
+    src = _prefix(model_file)
+    if save_model_path is None:
+        raise ValueError('save_model_path is required')
+    dst = _prefix(save_model_path)
+    params, buffers, meta, _exec = load_saved_artifacts(src)
+    dtype = jnp.dtype({'bfloat16': jnp.bfloat16, 'float16': jnp.float16,
+                       'fp16': jnp.float16, 'bf16': jnp.bfloat16}
+                      .get(str(mixed_precision), mixed_precision))
+    skip = set(black_list or ())
+
+    def cast(name, v):
+        if name in skip or not jnp.issubdtype(v.dtype, jnp.floating):
+            return np.asarray(v)
+        return np.asarray(v.astype(dtype))
+
+    state = {'params': {k: cast(k, v) for k, v in params.items()},
+             'buffers': {k: np.asarray(v) for k, v in buffers.items()}}
+    os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+    fsave(state, dst + '.pdparams')
+    meta = dict(meta, exported=False, poly_batch=False,
+                precision=str(np.dtype(dtype).name),
+                converted_from=os.path.basename(src))
+    with open(dst + '.pdmodel', 'w') as f:
+        json.dump(meta, f)
+    return dst
 
 
 Tensor = Tensor_     # reference name (fluid/inference Tensor binding)

@@ -69,3 +69,4 @@ class MaxUnPool2D(_LayerForExtras):
     def forward(self, x, indices):
         k, s, p, df, osz = self._args
         return functional.max_unpool2d(x, indices, k, s, p, df, osz)
+from ..utils.deprecated import deprecated  # noqa: F401,E402  (reference nn/__init__ re-export)

@@ -20,3 +20,9 @@ from .inplace_and_array import (  # noqa: F401
 from .register import install as _install
 
 _install()
+
+# symbols the reference exports from paddle.tensor that live in compat_api
+# here (compat_api only depends on core, so no import cycle)
+from ..compat_api import (  # noqa: F401,E402
+    add_n, diagonal, scatter_, set_printoptions, t, tanh_,
+    unique_consecutive, unstack)

@@ -208,3 +208,34 @@ def test_incubate_auto_checkpoint_and_layer_helper(tmp_path, monkeypatch):
     b = h.create_parameter(shape=[2], is_bias=True)
     assert list(w.shape) == [4, 2] and not w.stop_gradient
     assert float(np.abs(np.asarray(b.numpy())).sum()) == 0.0
+
+
+def test_inference_convert_to_mixed_precision(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference as inf
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    net.eval()
+    src = os.path.join(str(tmp_path), 'fp32')
+    paddle.jit.save(net, src,
+                    input_spec=[paddle.static.InputSpec([None, 4], 'float32')])
+    dst = inf.convert_to_mixed_precision(
+        src + '.pdmodel', save_model_path=os.path.join(str(tmp_path), 'bf16'))
+    from paddle_tpu.jit import load_saved_artifacts
+    params, _buffers, meta, exe = load_saved_artifacts(dst)
+    import jax.numpy as jnp
+    assert all(v.dtype == jnp.bfloat16 for v in params.values())
+    assert meta['precision'] == 'bfloat16' and exe is None
+    # serves through attach_layer at the stored precision
+    pred = inf.create_predictor(inf.Config(dst + '.pdmodel'))
+    pred.attach_layer(Net())
+    (out,) = pred.run([np.random.rand(3, 4).astype('float32')])
+    assert out.shape == (3, 2)
