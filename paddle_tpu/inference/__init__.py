@@ -136,13 +136,26 @@ class Predictor:
             layer = self._layer
             prec = self.config._precision
             params = self._params
-            if prec == PrecisionType.Bfloat16:
-                params = {k: (v.astype(jnp.bfloat16)
-                              if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                          for k, v in params.items()}
+            low = {PrecisionType.Bfloat16: jnp.bfloat16,
+                   PrecisionType.Half: jnp.float16}.get(prec)
+            def lower_tree(d):
+                return {k: (v.astype(low)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                        for k, v in d.items()}
             buffers = self._buffers
+            if low is not None:
+                params = lower_tree(params)
+                # buffers too (e.g. BN running stats): an f32 buffer would
+                # re-promote activations back to f32 mid-network
+                buffers = lower_tree(buffers)
 
             def infer(*xs):
+                if low is not None:
+                    # inputs must match the lowered param dtype (convs and
+                    # matmuls require homogeneous operand dtypes)
+                    xs = [x.astype(low)
+                          if jnp.issubdtype(x.dtype, jnp.floating) else x
+                          for x in xs]
                 out, _ = functional_call(layer, params, buffers, *xs)
                 return out
             fn = jax.jit(infer)

@@ -111,6 +111,11 @@ class StaticFunction:
         return jax.jit(pure), holder
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.enabled:
+            # reference semantics: ProgramTranslator.enable(False) makes
+            # @to_static functions run in plain dygraph (the converted fn
+            # preserves eager behaviour exactly)
+            return self._fn(*args, **kwargs)
         layer, call_args = self._bound_layer(args)
         arg_arrays = [a._value if isinstance(a, Tensor) else a for a in call_args]
         tensor_like = tuple(i for i, a in enumerate(arg_arrays)

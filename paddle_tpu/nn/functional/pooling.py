@@ -34,12 +34,17 @@ def _pool(x, kind, kernel, stride, padding, nd, data_format, ceil_mode=False,
     if isinstance(pad, str):
         pad_cfg = pad
     if kind == 'max':
-        init = (jnp.asarray(-jnp.inf, x.dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating)
-                else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype))
-        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pad_cfg)
-    # avg
-    zero = jnp.asarray(0, x.dtype)
+        # init MUST be a plain Python scalar (the monoid identity): jax only
+        # routes reduce_window to the differentiable reduce_window_max
+        # primitive when it recognizes identity+computation; an array init
+        # falls back to the generic primitive, which has no transpose rule
+        # ("Linearization failed ..." under value_and_grad)
+        init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else int(jnp.iinfo(x.dtype).min))
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
+                                     pad_cfg)
+    # avg — same scalar-identity rule as max above
+    zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
     summed = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides, pad_cfg)
     if exclusive and not count_include_pad and not isinstance(pad_cfg, str):
         ones = jnp.ones_like(x)

@@ -195,3 +195,30 @@ def test_jit_load_corrupt_pdexec_falls_back_to_state_dict():
             loaded = paddle.jit.load(path)
         assert isinstance(loaded, dict)
         assert any('unusable' in str(x.message) for x in w)
+
+
+def test_program_translator_enable_false_runs_dygraph():
+    """ProgramTranslator.enable(False): @to_static runs eagerly (reference
+    jit/dy2static/program_translator.py semantics)."""
+    from paddle_tpu.jit import ProgramTranslator
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)            # side effect visible per-call in eager
+        return x * 2
+
+    x = paddle.to_tensor(np.ones((2,), 'float32'))
+    ProgramTranslator.get_instance().enable(False)
+    try:
+        f(x)
+        f(x)
+        assert len(calls) == 2     # eager: body runs every call
+        assert not paddle.to_tensor(0.0)._value is None
+    finally:
+        ProgramTranslator.get_instance().enable(True)
+    n0 = len(calls)
+    f(x)
+    f(x)
+    # compiled: traced once (cache hit on the second call)
+    assert len(calls) == n0 + 1

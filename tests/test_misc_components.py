@@ -258,3 +258,71 @@ def test_nms_static_matches_eager_and_traces():
     assert jitted.shape == (40,)               # fixed size, -1 padded
     np.testing.assert_array_equal(jitted[:len(eager)], eager)
     assert np.all(jitted[len(eager):] == -1)
+
+
+def test_hapi_fit_maxpool_bn_model():
+    """Regression (r3): reduce_window init must be a scalar monoid identity
+    or value_and_grad over a max_pool model fails to linearize — this broke
+    hapi.Model.fit for every ResNet-style network."""
+    import paddle_tpu.nn as nn
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(1, 4, 3, padding=1)
+            self.bn = nn.BatchNorm2D(4)
+            self.fc = nn.Linear(4 * 4 * 4, 10)
+
+        def forward(self, x):
+            h = nn.functional.max_pool2d(self.conv(x), 2, 2)
+            h = self.bn(h)
+            h = nn.functional.avg_pool2d(h, 1)
+            return self.fc(h.reshape((h.shape[0], -1)))
+
+    X = np.random.rand(8, 1, 8, 8).astype('float32')
+    Y = np.random.randint(0, 10, (8, 1)).astype('int64')
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return 8
+
+    model = paddle.Model(Net())
+    model.prepare(paddle.optimizer.Adam(1e-3,
+                                        parameters=model.parameters()),
+                  paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(DS(), epochs=1, batch_size=4, verbose=0)
+
+
+def test_predictor_bf16_conv_bn_serving(tmp_path):
+    """Regression (r3): bf16 serving must lower params AND buffers AND
+    inputs, or BN's f32 running stats re-promote activations and convs see
+    mixed dtypes."""
+    import os
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference import Config, create_predictor
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2D(3, 4, 3, padding=1)
+            self.bn = nn.BatchNorm2D(4)
+            self.conv2 = nn.Conv2D(4, 2, 3, padding=1)
+
+        def forward(self, x):
+            return self.conv2(self.bn(self.conv1(x)))
+
+    net = Net()
+    net.eval()
+    path = os.path.join(str(tmp_path), 'bf16serve')
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec([1, 3, 8, 8], 'float32')])
+    cfg = Config(path + '.pdmodel')
+    cfg.set_precision('bfloat16')
+    pred = create_predictor(cfg)
+    pred.attach_layer(Net())
+    (out,) = pred.run([np.random.rand(1, 3, 8, 8).astype('float32')])
+    assert out.shape == (1, 2, 8, 8)
+    assert np.all(np.isfinite(out.astype('float32')))
