@@ -135,6 +135,12 @@ class Predictor:
             from ..nn.layer_base import functional_call
             layer = self._layer
             prec = self.config._precision
+            if prec == PrecisionType.Float32 and \
+                    self._meta.get('precision') in (PrecisionType.Bfloat16,
+                                                    PrecisionType.Half):
+                # model was offline-converted (convert_to_mixed_precision):
+                # honor its stored precision so inputs get lowered to match
+                prec = self._meta['precision']
             params = self._params
             low = {PrecisionType.Bfloat16: jnp.bfloat16,
                    PrecisionType.Half: jnp.float16}.get(prec)
@@ -241,8 +247,9 @@ def convert_to_mixed_precision(model_file, params_file=None,
             return np.asarray(v)
         return np.asarray(v.astype(dtype))
 
+    # buffers too: f32 BN running stats would re-promote activations
     state = {'params': {k: cast(k, v) for k, v in params.items()},
-             'buffers': {k: np.asarray(v) for k, v in buffers.items()}}
+             'buffers': {k: cast(k, v) for k, v in buffers.items()}}
     os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
     fsave(state, dst + '.pdparams')
     meta = dict(meta, exported=False, poly_batch=False,

@@ -34,13 +34,17 @@ def _pool(x, kind, kernel, stride, padding, nd, data_format, ceil_mode=False,
     if isinstance(pad, str):
         pad_cfg = pad
     if kind == 'max':
-        # init MUST be a plain Python scalar (the monoid identity): jax only
+        import numpy as np
+        # Floating: init MUST be the plain scalar monoid identity — jax only
         # routes reduce_window to the differentiable reduce_window_max
         # primitive when it recognizes identity+computation; an array init
         # falls back to the generic primitive, which has no transpose rule
-        # ("Linearization failed ..." under value_and_grad)
+        # ("Linearization failed ..." under value_and_grad).
+        # Integer: a dtype-MATCHED typed scalar (a weak python int would
+        # mismatch narrow int dtypes on the generic path); integer pooling
+        # is never differentiated, so losing the fast path is harmless.
         init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                else int(jnp.iinfo(x.dtype).min))
+                else np.dtype(x.dtype).type(np.iinfo(np.dtype(x.dtype)).min))
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
                                      pad_cfg)
     # avg — same scalar-identity rule as max above

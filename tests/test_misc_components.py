@@ -326,3 +326,53 @@ def test_predictor_bf16_conv_bn_serving(tmp_path):
     (out,) = pred.run([np.random.rand(1, 3, 8, 8).astype('float32')])
     assert out.shape == (1, 2, 8, 8)
     assert np.all(np.isfinite(out.astype('float32')))
+
+
+def test_max_pool_integer_dtypes():
+    """Regression (r3 review): integer max pool needs a dtype-matched init;
+    a weak python-int init crashed uint8/int8/int16 inputs."""
+    import paddle_tpu.nn.functional as F
+    for dt in ('uint8', 'int8', 'int16', 'int32'):
+        x = paddle.to_tensor(np.arange(16).reshape(1, 1, 4, 4).astype(dt))
+        out = F.max_pool2d(x, 2, 2)
+        np.testing.assert_array_equal(
+            np.asarray(out.numpy(), 'int64').reshape(-1), [5, 7, 13, 15])
+
+
+def test_converted_bf16_model_serves_without_config(tmp_path):
+    """Regression (r3 review): a convert_to_mixed_precision'd model must
+    serve with a DEFAULT config — the Predictor honors the stored
+    precision, and converted buffers are bf16 too."""
+    import os
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference import (
+        Config, convert_to_mixed_precision, create_predictor)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 4, 3, padding=1)
+            self.bn = nn.BatchNorm2D(4)
+
+        def forward(self, x):
+            return self.bn(self.conv(x))
+
+    net = Net()
+    net.eval()
+    src = os.path.join(str(tmp_path), 'src')
+    paddle.jit.save(net, src, input_spec=[
+        paddle.static.InputSpec([1, 3, 8, 8], 'float32')])
+    dst = convert_to_mixed_precision(
+        src + '.pdmodel',
+        save_model_path=os.path.join(str(tmp_path), 'dst'))
+    from paddle_tpu.jit import load_saved_artifacts
+    params, buffers, meta, _ = load_saved_artifacts(dst)
+    float_buffers = [v for v in buffers.values()
+                     if jnp.issubdtype(v.dtype, jnp.inexact)]
+    assert float_buffers and all(v.dtype == jnp.bfloat16
+                                 for v in float_buffers)
+    pred = create_predictor(Config(dst + '.pdmodel'))   # default precision
+    pred.attach_layer(Net())
+    (out,) = pred.run([np.random.rand(1, 3, 8, 8).astype('float32')])
+    assert np.all(np.isfinite(out.astype('float32')))
