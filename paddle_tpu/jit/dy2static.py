@@ -8,8 +8,10 @@ its ProgramDesc. TPU-native: the same AST rewrite, but targeting
 with a runtime dispatch that preserves plain-Python semantics whenever the
 condition is NOT a traced tensor, so eager behaviour is unchanged.
 
-Scope (minimal viable, VERDICT r2 #4): tensor-conditioned ``if``/``else``
-and ``while`` with single-assignment bodies. Unsupported constructs
+Scope: tensor-conditioned ``if``/``else``, ``while``, ``for .. in
+range(...)`` (→ lax.cond / lax.while_loop), and ``and``/``or``/``not`` in
+conditions (→ jnp.logical_* when traced, exact short-circuit otherwise),
+over bodies that only rebind local variables. Unsupported constructs
 (return/break escaping a tensor branch, attribute/subscript stores, a var
 bound in only one branch) raise Dy2StaticError with an actionable message
 instead of jax's TracerBoolConversionError.
