@@ -1,18 +1,37 @@
-"""paddle.onnx parity. Reference: python/paddle/onnx/export.py (delegates to
-the external paddle2onnx package).
+"""paddle.onnx parity. Reference: python/paddle/onnx/export.py (delegates
+to the external paddle2onnx package — the reference itself cannot emit ONNX
+without that dependency either).
 
-Offline/TPU-native: ONNX export is gated (needs the onnx pip package); the
-portable interchange format here is StableHLO (jit.save writes
-``<path>.stablehlo``), which XLA/IREE toolchains consume directly.
+TPU-native: the portable interchange format is StableHLO — ``jit.save``
+writes ``<path>.stablehlo`` (textual MLIR consumed by XLA/IREE toolchains)
+plus ``<path>.pdexec`` (a serialized ``jax.export`` program reloadable
+anywhere jax runs). ``export`` therefore always produces the StableHLO
+artifacts; emitting a ``.onnx`` protobuf additionally requires the ``onnx``
+package (absent in this zero-egress image; torch's exporter needs it too),
+in which case the StableHLO path is reported in the error.
 """
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer``. Always writes the StableHLO + serialized-program
+    artifacts (the working interchange path); raises with guidance if the
+    caller insists on a literal .onnx protobuf, which needs the unavailable
+    ``onnx`` dependency — mirroring the reference's hard dependency on
+    paddle2onnx."""
+    from . import jit
+
+    base = path[:-len('.onnx')] if path.endswith('.onnx') else path
+    jit.save(layer, base, input_spec=input_spec)
     try:
         import onnx  # noqa: F401
     except ImportError as e:
         raise RuntimeError(
-            'onnx is not installed in this environment. paddle_tpu exports '
-            'StableHLO instead: use paddle_tpu.jit.save(layer, path, '
-            'input_spec=...) and consume <path>.stablehlo.') from e
-    raise NotImplementedError('direct ONNX emission planned (round 2+)')
+            f'the onnx package is not installed in this environment, so a '
+            f'.onnx protobuf cannot be emitted (the reference delegates to '
+            f'paddle2onnx for the same reason). The portable program was '
+            f'still exported: {base}.stablehlo (StableHLO MLIR) and '
+            f'{base}.pdexec (serialized jax.export program), servable via '
+            f'paddle_tpu.inference.create_predictor.') from e
+    raise NotImplementedError(
+        'onnx package detected but StableHLO->ONNX conversion is not '
+        'wired; consume the StableHLO artifact directly')

@@ -3,6 +3,8 @@ amp scaler, profiler, checkpoint manager, clip, incubate."""
 import os
 import tempfile
 
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -376,3 +378,30 @@ def test_converted_bf16_model_serves_without_config(tmp_path):
     pred.attach_layer(Net())
     (out,) = pred.run([np.random.rand(1, 3, 8, 8).astype('float32')])
     assert np.all(np.isfinite(out.astype('float32')))
+
+
+def test_onnx_export_writes_portable_artifacts(tmp_path):
+    """paddle.onnx.export always produces the StableHLO interchange
+    artifacts; the .onnx protobuf itself is gated on the unavailable onnx
+    package with an actionable error."""
+    import os
+    import paddle_tpu.nn as nn
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    net.eval()
+    path = os.path.join(str(tmp_path), 'm.onnx')
+    with pytest.raises(RuntimeError) as ei:
+        paddle.onnx.export(net, path, input_spec=[
+            paddle.static.InputSpec([None, 4], 'float32')])
+    assert 'stablehlo' in str(ei.value).lower()
+    base = os.path.join(str(tmp_path), 'm')
+    assert os.path.exists(base + '.stablehlo')
+    assert os.path.exists(base + '.pdexec')
