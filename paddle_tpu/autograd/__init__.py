@@ -21,9 +21,16 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """paddle.grad: returns grads of outputs w.r.t. inputs without touching
     ``.grad`` of unrelated leaves (we snapshot/restore)."""
+    from ..core.tensor import collect_leaf_tensors
     outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
-    snap = [(t, t.grad) for t in ins]
+    # snapshot .grad of EVERY reachable leaf (e.g. module weights), not just
+    # the requested inputs: backward accumulates into all of them, and
+    # paddle.grad must leave everything except its own return values alone
+    leaves = {id(t): t for o in outs for t in collect_leaf_tensors(o)}
+    for t in ins:
+        leaves.setdefault(id(t), t)
+    snap = [(t, t.grad) for t in leaves.values()]
     prev_sg = [t.stop_gradient for t in ins]
     for t in ins:
         t.grad = None
@@ -32,15 +39,19 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if isinstance(gts, Tensor):
         gts = [gts]
     for o, g in zip(outs, gts):
-        run_backward(o, g, retain_graph=True if retain_graph is None else retain_graph)
+        run_backward(o, g,
+                     retain_graph=True if retain_graph is None
+                     else retain_graph,
+                     create_graph=create_graph)
     result = []
     for t in ins:
         g = t.grad
         if g is None and not allow_unused:
             g = Tensor(jnp.zeros(t.shape, t.dtype))
         result.append(g)
-    for (t, old), sg in zip(snap, prev_sg):
+    for t, old in snap:           # restore every touched leaf, inputs too
         t.grad = old
+    for t, sg in zip(ins, prev_sg):
         t.stop_gradient = sg
     return result
 

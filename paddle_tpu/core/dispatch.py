@@ -87,7 +87,9 @@ def apply_op(pure_fn, *args, **kwargs):
         node_vjp = lambda cots: vjp_fn(container(cots))[0]
     else:
         node_vjp = lambda cots: vjp_fn(cots[0])[0]
-    node = TapeNode(node_vjp, diff_tensors, out_tensors)
+    node = TapeNode(node_vjp, diff_tensors, out_tensors,
+                    replay_fn=pure_on_diff, out_is_seq=is_seq,
+                    out_container=container if is_seq else None)
     for i, t in enumerate(out_tensors):
         t._node = node
         t._out_idx = i

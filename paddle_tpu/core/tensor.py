@@ -47,16 +47,27 @@ def enable_grad_ctx():
 
 
 class TapeNode:
-    """One recorded differentiable op: vjp closure + input/output bookkeeping."""
+    """One recorded differentiable op: vjp closure + input/output bookkeeping.
 
-    __slots__ = ('vjp_fn', 'inputs', 'out_specs', 'out_refs', 'index', '__weakref__')
+    ``replay_fn`` (when present) is the node's pure primal function over the
+    list of diff-input VALUES — double-backward (paddle.grad with
+    create_graph=True) re-derives jax.vjp from it so the backward pass can
+    itself be taped; ``out_is_seq``/``out_container`` describe the primal
+    output structure for rebuilding cotangents."""
+
+    __slots__ = ('vjp_fn', 'inputs', 'out_specs', 'out_refs', 'index',
+                 'replay_fn', 'out_is_seq', 'out_container', '__weakref__')
     _counter = 0
 
-    def __init__(self, vjp_fn, inputs, outputs):
+    def __init__(self, vjp_fn, inputs, outputs, replay_fn=None,
+                 out_is_seq=False, out_container=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs              # list[Tensor] (the diff inputs)
         self.out_specs = [(o.shape, o.dtype) for o in outputs]
         self.out_refs = [weakref.ref(o) for o in outputs]
+        self.replay_fn = replay_fn
+        self.out_is_seq = out_is_seq
+        self.out_container = out_container
         TapeNode._counter += 1
         self.index = TapeNode._counter
 
@@ -202,7 +213,29 @@ def builtins_bool(x):
     return builtins.bool(np.asarray(x))
 
 
-def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
+def _node_backward_taped(node, cot_tensors):
+    """Differentiable backward of one node: re-derive jax.vjp from the
+    node's replayed primal function and run it THROUGH the dispatch layer,
+    so the produced gradients carry their own tape nodes (double-backward,
+    reference: the grad-of-grad op graph dy2static/backward builds)."""
+    from .dispatch import apply_op
+
+    def bwd_pure(primal_vals, cot_vals):
+        import jax as _jax
+        _, vjp = _jax.vjp(node.replay_fn, list(primal_vals))
+        cot = (node.out_container(cot_vals) if node.out_is_seq
+               else cot_vals[0])
+        (gs,) = vjp(cot)
+        return tuple(gs)
+
+    out = apply_op(bwd_pure, list(node.inputs), list(cot_tensors))
+    return out if isinstance(out, tuple) else (out,)
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
+    if create_graph:
+        return _run_backward_create_graph(root, grad_tensor)
     if root._node is None:
         # leaf: grad of itself
         if not root.stop_gradient:
@@ -264,6 +297,86 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
             if t._node is not None:
                 k = id(t)
                 tensor_of[k] = t
+                grads[k] = g if k not in grads else grads[k] + g
+
+
+def collect_leaf_tensors(root: Tensor):
+    """All leaf tensors reachable from ``root``'s tape (the tensors whose
+    ``.grad`` a backward pass would touch)."""
+    leaves = []
+    if root._node is None:
+        return [root]
+    seen = set()
+    stack = [root._node]
+    seen_t = set()
+    while stack:
+        n = stack.pop()
+        if n.index in seen:
+            continue
+        seen.add(n.index)
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+            elif id(t) not in seen_t:
+                seen_t.add(id(t))
+                leaves.append(t)
+    return leaves
+
+
+def _run_backward_create_graph(root: Tensor, grad_tensor=None):
+    """Backward pass whose cotangent arithmetic is itself taped: all
+    bookkeeping holds TENSORS and every node's vjp re-runs through the
+    dispatch layer (_node_backward_taped), so resulting .grad tensors are
+    differentiable (paddle.grad(..., create_graph=True) semantics). The
+    graph is implicitly retained (node.vjp_fn is never dropped)."""
+    seed = (Tensor(jnp.ones_like(root._value)) if grad_tensor is None else
+            (grad_tensor if isinstance(grad_tensor, Tensor)
+             else Tensor(jnp.asarray(grad_tensor))))
+    if root._node is None:
+        if not root.stop_gradient:
+            root.grad = seed if root.grad is None else root.grad + seed
+        return
+
+    nodes = {}
+    stack = [root._node]
+    while stack:
+        n = stack.pop()
+        if n.index in nodes:
+            continue
+        nodes[n.index] = n
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+
+    grads = {id(root): seed}           # id(tensor) -> cotangent TENSOR
+
+    for idx in sorted(nodes.keys(), reverse=True):
+        node = nodes[idx]
+        if node.replay_fn is None:
+            raise RuntimeError(
+                'create_graph=True needs the node replay payload; this '
+                'graph was built without it (PyLayer/custom op?)')
+        cots = []
+        any_grad = False
+        for i, (shape, dt) in enumerate(node.out_specs):
+            ref = node.out_refs[i]()
+            g = grads.pop(id(ref), None) if ref is not None else None
+            if g is None:
+                cots.append(Tensor(jnp.zeros(shape, dt)))
+            else:
+                any_grad = True
+                cots.append(g)
+        if not any_grad:
+            continue
+        in_grads = _node_backward_taped(node, cots)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if t._node is None or t._retain:
+                if not t.stop_gradient:
+                    t.grad = g if t.grad is None else t.grad + g
+            if t._node is not None:
+                k = id(t)
                 grads[k] = g if k not in grads else grads[k] + g
 
 

@@ -1,0 +1,74 @@
+"""paddle.grad(create_graph=True): higher-order gradients.
+Reference: fluid dygraph double-grad (python/paddle/fluid/dygraph/base.py
+grad + the grad-op-of-grad-op machinery); canonical user: WGAN-GP
+gradient penalty."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _var(v):
+    t = paddle.to_tensor(np.asarray(v, dtype='float32'))
+    t.stop_gradient = False
+    return t
+
+
+def test_second_and_third_order_scalar():
+    x = _var([2.0])
+    y = x * x * x
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0])          # 3x^2
+    (g2,) = paddle.grad([g], [x], create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), [12.0])         # 6x
+    (g3,) = paddle.grad([g2], [x])
+    np.testing.assert_allclose(g3.numpy(), [6.0])          # 6
+
+
+def test_second_order_transcendental():
+    x = _var([0.5])
+    z = paddle.sin(x) * x
+    (g,) = paddle.grad([z], [x], create_graph=True)
+    want1 = np.sin(0.5) + 0.5 * np.cos(0.5)
+    np.testing.assert_allclose(g.numpy(), [want1], rtol=1e-5)
+    (g2,) = paddle.grad([g], [x])
+    want2 = 2 * np.cos(0.5) - 0.5 * np.sin(0.5)
+    np.testing.assert_allclose(g2.numpy(), [want2], rtol=1e-5)
+
+
+def test_second_order_through_matmul():
+    rng = np.random.RandomState(0)
+    w = _var(rng.rand(3, 3))
+    x = paddle.to_tensor(rng.rand(4, 3).astype('float32'))
+    y = (paddle.matmul(x, w) ** 2).sum()
+    (gw,) = paddle.grad([y], [w], create_graph=True)
+    # d/dw sum((xw)^2) = 2 x^T x w; second grad of sum(gw) wrt w:
+    (gw2,) = paddle.grad([gw.sum()], [w])
+    xtx = x.numpy().T @ x.numpy()
+    want = 2 * xtx @ np.ones((3, 3))
+    np.testing.assert_allclose(gw2.numpy(), want, rtol=1e-4)
+
+
+def test_gradient_penalty_training_step():
+    """WGAN-GP shape: penalty = (||d critic/d input|| - 1)^2 participates
+    in the loss, so its OWN gradients flow into the critic weights."""
+    import paddle_tpu.nn as nn
+    paddle.seed(11)
+    critic = nn.Linear(4, 1)
+    x = _var(np.random.RandomState(1).rand(8, 4))
+    out = critic(x).sum()
+    (gx,) = paddle.grad([out], [x], create_graph=True)
+    gp = ((gx * gx).sum() - 1.0) ** 2
+    gp.backward()
+    gw = critic.weight.grad
+    assert gw is not None
+    # analytic: out=sum(xW+b) -> gx = 1 @ W^T rows; gp = (8*||w||^2 - 1)^2
+    w = critic.weight.numpy().reshape(-1)
+    want = 2 * (8 * (w ** 2).sum() - 1.0) * 16 * w
+    np.testing.assert_allclose(gw.numpy().reshape(-1), want, rtol=1e-4)
+
+
+def test_create_graph_false_grads_are_detached():
+    x = _var([3.0])
+    y = x * x
+    (g,) = paddle.grad([y], [x])        # default: no graph
+    assert g._node is None
