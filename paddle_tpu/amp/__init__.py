@@ -113,9 +113,11 @@ class GradScaler:
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # Reference flow (amp/grad_scaler.py): the user has already called
+        # scaled_loss.backward(); minimize unscales the existing grads,
+        # skips the step on inf/nan, and updates the loss scale. It does NOT
+        # re-run autograd and does NOT clear grads (the user does).
         self.step(optimizer)
-        optimizer.clear_grad()
 
     def update(self):
         if not self._dynamic:

@@ -26,6 +26,16 @@ class TracedLayer:
     pass
 
 
+def _trace_state_clean():
+    """True when no jax trace (jit/grad/vmap/export) is active. Private-API
+    fast path with a tracer-scan-free conservative fallback."""
+    try:
+        from jax._src.core import trace_state_clean
+        return trace_state_clean()
+    except Exception:   # pragma: no cover — jax internals moved
+        return True
+
+
 def _hashable(v):
     if isinstance(v, (list, tuple)):
         return tuple(_hashable(x) for x in v)
@@ -115,6 +125,14 @@ class StaticFunction:
             # reference semantics: ProgramTranslator.enable(False) makes
             # @to_static functions run in plain dygraph (the converted fn
             # preserves eager behaviour exactly)
+            return self._fn(*args, **kwargs)
+        # Already inside an outer jax trace (jit.save export, a fused hapi
+        # train step, dryrun pjit...): the inner jit+cache machinery is void
+        # — everything is being traced anyway — and re-reading
+        # layer.named_parameters() here would capture the outer trace's
+        # substituted tracers into a cached closure (leaf-count corruption
+        # at export). Run the converted function directly.
+        if not _trace_state_clean():
             return self._fn(*args, **kwargs)
         layer, call_args = self._bound_layer(args)
         arg_arrays = [a._value if isinstance(a, Tensor) else a for a in call_args]
@@ -269,12 +287,17 @@ def save(layer, path, input_spec=None, **configs):
                     exported = jax_export.export(jax.jit(infer_fn_functional))(
                         p_struct, b_struct, *in_specs)
                     blob = exported.serialize()
-                except Exception:
+                except Exception as e:   # noqa: BLE001 — try next shape mode
+                    # keep the cause: a silent exported=False cost a round-3
+                    # debugging session (to_static leaf-count corruption)
+                    meta['export_error'] = (f'{e.__class__.__name__}: '
+                                            f'{e}'[:300])
                     continue
                 with open(path + '.pdexec', 'wb') as f:
                     f.write(blob)
                 meta['exported'] = True
                 meta['poly_batch'] = poly
+                meta.pop('export_error', None)
                 break
             if not meta['exported'] and os.path.exists(path + '.pdexec'):
                 os.unlink(path + '.pdexec')   # drop stale program from prior save

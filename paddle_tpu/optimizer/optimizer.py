@@ -115,10 +115,19 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        loss.backward()
+        # Reference dygraph semantics (optimizer.py:786 in the reference):
+        # backward() only COLLECTS grads already produced by loss.backward();
+        # it never re-runs autograd — so `loss.backward(); opt.minimize(loss)`
+        # (the AMP GradScaler flow) must not double-backward. When NO grad
+        # exists at all we do run autograd (fluid static-style
+        # `minimize(loss)`-only programs keep working; in that state the
+        # reference would silently no-op).
+        if all(p.grad is None for p in self._parameters):
+            loss.backward()
         self.step()
+        params_grads = [(p, p.grad) for p in self._parameters]
         self.clear_grad()
-        return None, [(p, p.grad) for p in self._parameters]
+        return None, params_grads
 
     # ---- state dict ------------------------------------------------------
     def state_dict(self):

@@ -29,7 +29,12 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for s in x.shape[num_flatten_dims:]:
         in_dim *= s
     from ..tensor.manipulation import reshape
-    flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    # leading dim as -1: a static.data placeholder carries a build-time batch
+    # of 1, but the Executor replays this op with the real fed batch
+    lead = list(x.shape[:num_flatten_dims])
+    if lead:
+        lead[0] = -1
+    flat = reshape(x, lead + [in_dim])
     w = _make_param((in_dim, size), weight_attr, I.XavierNormal())
     b = _make_param((size,), bias_attr, I.Constant(0.0))
     out = F.linear(flat, w, b)

@@ -1,0 +1,242 @@
+"""Whole user-journey programs, written exactly as a PaddlePaddle 2.1 user
+would write them (reference idioms: dygraph train loop with
+loss.backward()/opt.step()/opt.clear_grad(), DataLoader over a custom
+Dataset, @to_static + jit.save + Predictor serving, GradScaler AMP loop,
+static Program/Executor, state_dict save/load round trip).
+
+Import parity says every symbol resolves; these tests check the journeys
+COMPOSE — the way the reference's own end-to-end examples do (e.g.
+/root/reference/python/paddle/tests/test_model.py,
+/root/reference/python/paddle/fluid/tests/unittests/test_jit_save_load.py).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _synthetic_clf_data(n=64, d=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, classes).astype('float32')
+    x = rng.randn(n, d).astype('float32')
+    y = (x @ w).argmax(axis=1).astype('int64')
+    return x, y
+
+
+class _ClfDataset(paddle.io.Dataset):
+    def __init__(self, x, y):
+        self.x, self.y = x, y
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def test_dygraph_training_journey():
+    """Custom Dataset -> DataLoader -> dygraph loop with scheduler + clip."""
+    x, y = _synthetic_clf_data()
+    loader = paddle.io.DataLoader(_ClfDataset(x, y), batch_size=16,
+                                  shuffle=True, drop_last=True)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.05, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.Momentum(
+        learning_rate=sched, parameters=net.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    loss_fn = nn.CrossEntropyLoss()
+
+    first = last = None
+    for epoch in range(4):
+        for xb, yb in loader:
+            logits = net(xb)
+            loss = loss_fn(logits, yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        sched.step()
+    assert last < first
+    # the scheduler actually decayed
+    assert abs(sched.get_lr() - 0.05 * 0.5 ** 2) < 1e-9
+
+
+def test_lstm_sequence_classifier_journey():
+    """Embedding -> LSTM -> Linear trained with Adam, 2.1 dygraph style."""
+    rng = np.random.RandomState(1)
+    vocab, seqlen, n = 50, 12, 48
+    toks = rng.randint(1, vocab, size=(n, seqlen)).astype('int64')
+    labels = (toks[:, 0] % 2).astype('int64')
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, 24)
+            self.lstm = nn.LSTM(24, 32)
+            self.fc = nn.Linear(32, 2)
+
+        def forward(self, x):
+            h = self.emb(x)
+            out, _ = self.lstm(h)
+            return self.fc(out[:, -1])
+
+    net = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    losses = []
+    for _ in range(8):
+        logits = net(paddle.to_tensor(toks))
+        loss = F.cross_entropy(logits, paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_to_static_save_serve_journey(tmp_path):
+    """Train eager -> @to_static -> jit.save -> jit.load AND Predictor:
+    all three serving surfaces agree with the dygraph model."""
+    x, y = _synthetic_clf_data(n=32)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    for _ in range(5):
+        loss = F.cross_entropy(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    net.eval()
+    eager_out = net(paddle.to_tensor(x)).numpy()
+
+    static_net = paddle.jit.to_static(
+        net, input_spec=[paddle.static.InputSpec([None, 16], 'float32')])
+    np.testing.assert_allclose(static_net(paddle.to_tensor(x)).numpy(),
+                               eager_out, rtol=2e-5, atol=2e-5)
+
+    path = os.path.join(str(tmp_path), 'clf')
+    paddle.jit.save(static_net, path)
+
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(np.asarray(loaded(x)), eager_out,
+                               rtol=2e-5, atol=2e-5)
+
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(path + '.pdmodel'))
+    np.testing.assert_allclose(np.asarray(pred.run([x])[0]), eager_out,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_amp_gradscaler_journey():
+    """2.1 AMP loop: auto_cast forward + scaler.scale(loss).backward() +
+    scaler.minimize, fp32 master weights keep improving."""
+    x, y = _synthetic_clf_data(n=32)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    losses = []
+    for _ in range(10):
+        with paddle.amp.auto_cast():
+            loss = F.cross_entropy(net(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y))
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.minimize(opt, scaled)
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_static_program_journey():
+    """Declarative static-graph: enable_static + program_guard + static.data
+    + static.nn.fc + Executor.run with feed/fetch (the reference's pre-2.0
+    main mode; 2.x requires paddle.enable_static() first)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            inp = paddle.static.data('x', [None, 16], 'float32')
+            hid = paddle.static.nn.fc(inp, 32, activation='relu')
+            out = paddle.static.nn.fc(hid, 4)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(8, 16).astype('float32')
+        res, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    finally:
+        paddle.disable_static()
+    assert np.asarray(res).shape == (8, 4)
+
+
+def test_state_dict_roundtrip_journey(tmp_path):
+    """paddle.save/paddle.load of nested state (model + optimizer) restores
+    byte-identical behavior in a fresh model instance."""
+    x, y = _synthetic_clf_data(n=32)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    for _ in range(3):
+        loss = F.cross_entropy(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    mpath = os.path.join(str(tmp_path), 'model.pdparams')
+    opath = os.path.join(str(tmp_path), 'opt.pdopt')
+    paddle.save(net.state_dict(), mpath)
+    paddle.save(opt.state_dict(), opath)
+
+    net2 = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    net2.set_state_dict(paddle.load(mpath))
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01,
+                                 parameters=net2.parameters())
+    opt2.set_state_dict(paddle.load(opath))
+
+    net.eval(), net2.eval()
+    np.testing.assert_array_equal(net(paddle.to_tensor(x)).numpy(),
+                                  net2(paddle.to_tensor(x)).numpy())
+    # resumed optimizer continues identically for one more step
+    for m, o in ((net, opt), (net2, opt2)):
+        m.train()
+        loss = F.cross_entropy(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    np.testing.assert_allclose(net(paddle.to_tensor(x)).numpy(),
+                               net2(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fleet_dp_journey():
+    """fleet-style data-parallel training as a 2.1 user writes it:
+    fleet.init(is_collective) + distributed_optimizer + DataParallel-ish
+    sharded step over the 8-device CPU mesh."""
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 8, 'mp_degree': 1,
+                               'pp_degree': 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    x, y = _synthetic_clf_data(n=64)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    opt = fleet.distributed_optimizer(opt)
+
+    losses = []
+    for _ in range(5):
+        loss = F.cross_entropy(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
