@@ -111,6 +111,11 @@ class _DistributedOptimizer:
     def __init__(self, opt, strategy):
         if strategy is not None and strategy.lars:
             opt = self._wrap_lars(opt, strategy)
+        if strategy is not None and getattr(strategy, 'asp', False):
+            # reference: fleet/meta_optimizers/asp_optimizer.py — keep
+            # pruned weights n:m sparse across updates
+            from ... import sparsity
+            opt = sparsity.decorate(opt)
         self._inner = opt
         self._strategy = strategy
 
@@ -149,12 +154,34 @@ class _DistributedOptimizer:
             state = shard_opt_state(state, params)
         return state
 
+    def set_asp_masks(self, mask_tree):
+        """Register the mask tree from sparsity.prune_tree so the functional
+        (pjit) path keeps weights n:m sparse; the eager step() path is
+        covered by sparsity.decorate instead."""
+        self._asp_masks = mask_tree
+
+    def _asp_post(self, new_p):
+        if getattr(self, '_asp_masks', None) is not None:
+            from ... import sparsity
+            return sparsity.apply_mask_tree(new_p, self._asp_masks)
+        if self._strategy is not None and getattr(self._strategy, 'asp', False) \
+                and not getattr(self, '_asp_warned', False):
+            import warnings
+            warnings.warn(
+                'strategy.asp is on but no mask tree is registered for the '
+                'functional path — call set_asp_masks(prune_tree(params)[1]) '
+                'or sparsity decays to dense silently', stacklevel=3)
+            self._asp_warned = True
+        return new_p
+
     def functional_apply(self, params, grads, opt_state, lr=None):
         stage = 1
         if self._strategy and self._strategy.sharding:
             stage = int(getattr(self._strategy.sharding_configs, 'stage', 1) or 1)
         if stage < 2:
-            return self._inner.functional_apply(params, grads, opt_state, lr)
+            new_p, new_s = self._inner.functional_apply(params, grads,
+                                                        opt_state, lr)
+            return self._asp_post(new_p), new_s
         # ZeRO-2/3: constrain grads dp-sharded so XLA emits reduce-scatter;
         # stage 3 additionally keeps params sharded (FSDP-style)
         from ...parallel import zero
@@ -169,7 +196,7 @@ class _DistributedOptimizer:
             # ZeRO-2 keeps params replicated: without this constraint GSPMD
             # propagates the dp-sharded grad layout into the updated params
             new_p = zero.replicate(new_p, topo.mesh)
-        return new_p, new_s
+        return self._asp_post(new_p), new_s
 
     def step(self):
         return self._inner.step()

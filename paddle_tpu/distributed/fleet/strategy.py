@@ -38,6 +38,9 @@ class DistributedStrategy:
                                  epsilon=1e-9, exclude_from_weight_decay=[])
         self.localsgd = False
         self.localsgd_configs = _Cfg(k_steps=4, begin_step=1)
+        # n:m structured-sparsity training (reference asp_optimizer.py);
+        # masks via paddle_tpu.sparsity, re-applied after every step
+        self.asp = False
         # DGC and fp16_allreduce are NCCL-bandwidth workarounds; on a TPU
         # mesh collectives ride ICI and XLA already all-reduces in the
         # compute dtype, so both are accepted-but-N/A (documented SURVEY §2)

@@ -44,3 +44,38 @@ class core:
     @staticmethod
     def get_cuda_device_count():
         return 0
+
+
+class _MixedPrecisionOptimizer:
+    """fluid.contrib.mixed_precision.decorate(optimizer) — the fluid-era AMP
+    entry point (reference: fluid/contrib/mixed_precision/decorator.py):
+    minimize() runs scaled-loss backward + unscale + inf-skip via the 2.x
+    GradScaler machinery."""
+
+    def __init__(self, optimizer, init_loss_scaling=2. ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        from ..amp import GradScaler
+        self._inner = optimizer
+        self._scaler = GradScaler(init_loss_scaling=init_loss_scaling,
+                                  use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+    def minimize(self, loss, *a, **kw):
+        scaled = self._scaler.scale(loss)
+        scaled.backward()
+        self._scaler.step(self._inner)
+        self._inner.clear_grad()
+        return None, []
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+
+class _mixed_precision_ns:
+    decorate = staticmethod(_MixedPrecisionOptimizer)
+
+
+class contrib:
+    """fluid.contrib shim: the 2.1 home of ASP sparsity (reference:
+    fluid/contrib/sparsity) and mixed-precision training."""
+    from .. import sparsity
+    mixed_precision = _mixed_precision_ns

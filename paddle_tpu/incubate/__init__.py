@@ -201,3 +201,6 @@ class LayerHelper:
         init = default_initializer or (Constant(0.0) if is_bias
                                        else XavierNormal())
         return Tensor(init(shape, dtype), stop_gradient=False)
+
+# ASP structured sparsity (reference later moves fluid.contrib.sparsity here)
+from .. import sparsity as asp  # noqa: F401,E402
