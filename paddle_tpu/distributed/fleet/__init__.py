@@ -142,8 +142,10 @@ class _DistributedOptimizer:
         from ...parallel.localsgd import make_localsgd_train_step
         mesh = mesh or get_mesh()
         k = self._strategy.localsgd_configs.k_steps or 4
+        # _asp_post re-masks after every LOCAL update (and carries the
+        # no-mask-registered warning for strategy.asp)
         return make_localsgd_train_step(loss_fn, self._inner, mesh,
-                                        k_steps=k)
+                                        k_steps=k, post_update=self._asp_post)
 
     def __getattr__(self, k):
         return getattr(self._inner, k)

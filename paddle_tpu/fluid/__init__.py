@@ -53,11 +53,17 @@ class _MixedPrecisionOptimizer:
     GradScaler machinery."""
 
     def __init__(self, optimizer, init_loss_scaling=2. ** 15,
-                 use_dynamic_loss_scaling=True, **kw):
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+                 **kw):
         from ..amp import GradScaler
         self._inner = optimizer
-        self._scaler = GradScaler(init_loss_scaling=init_loss_scaling,
-                                  use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+        self._scaler = GradScaler(
+            init_loss_scaling=init_loss_scaling,
+            use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio)
 
     def minimize(self, loss, *a, **kw):
         scaled = self._scaler.scale(loss)

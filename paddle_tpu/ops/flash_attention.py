@@ -29,8 +29,19 @@ try:
 except Exception:   # pragma: no cover
     _HAS_PALLAS = False
 
-_BQ = 256
-_BK = 256
+def _env_block(name, default):
+    """Tunable block size: positive multiple of 128 (TPU sublane tiling);
+    anything else falls back to the default rather than crashing or feeding
+    Mosaic an untileable shape."""
+    try:
+        v = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    return v if v > 0 and v % 128 == 0 else default
+
+
+_BQ = _env_block('PADDLE_TPU_FLASH_BQ', 256)   # q-block rows
+_BK = _env_block('PADDLE_TPU_FLASH_BK', 256)   # k/v-block rows
 _LANES = 128   # TPU lane width; lse is stored lane-broadcast to tile cleanly
 
 _INTERPRET = False   # run kernels through the pallas interpreter (CPU CI)
@@ -56,6 +67,7 @@ def flash_attention_available(q, k, v, mask):
     _, s_q, _, d = (int(x) for x in q.shape)
     s_k = int(k.shape[1])
     return (s_q == s_k and s_q % _BQ == 0 and s_k % _BK == 0 and
+            _BQ % _BK == 0 and   # causal loop bounds assume bq = r*bk
             d in (64, 128, 256) and q.dtype in (jnp.float32, jnp.bfloat16))
 
 

@@ -48,12 +48,15 @@ def collapse_replicas(tree):
     return jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), tree)
 
 
-def make_localsgd_train_step(loss_fn, opt, mesh, k_steps=4, axis='dp'):
+def make_localsgd_train_step(loss_fn, opt, mesh, k_steps=4, axis='dp',
+                             post_update=None):
     """Returns step(params_rep, opt_state_rep, batch, step_idx, lr)
     -> (mean_loss, new_params_rep, new_opt_state_rep).
 
     ``loss_fn(params, batch) -> scalar``; ``batch`` leading dim must divide
     by the dp degree; params_rep/opt_state_rep from replicate_for_localsgd.
+    ``post_update(params) -> params`` runs after every local optimizer
+    update (e.g. ASP mask re-application) — traced into the step.
     """
     shard_map = _shard_map()
     rep_spec = P(axis)        # leading replica dim on every leaf
@@ -66,6 +69,8 @@ def make_localsgd_train_step(loss_fn, opt, mesh, k_steps=4, axis='dp'):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         # NO grad psum here — local step is the point of LocalSGD
         params, state = opt.functional_apply(params, grads, state, lr)
+        if post_update is not None:
+            params = post_update(params)
         do_avg = (step_idx + 1) % k_steps == 0
         # pvary re-marks the pmean result as device-varying so both cond
         # branches carry the same vma type under shard_map

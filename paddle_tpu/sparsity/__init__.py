@@ -84,6 +84,10 @@ def check_mask_1d(mat, n, m):
 def _blocks(mat, m):
     """[R, C] -> [B, m, m] row-major blocks (R, C divisible by m)."""
     r, c = mat.shape
+    if r % m or c % m:
+        raise ValueError(
+            f'2D n:m pattern needs both dims divisible by m={m}; got '
+            f'({r}, {c})')
     return (mat.reshape(r // m, m, c // m, m)
                .transpose(0, 2, 1, 3)
                .reshape(-1, m, m))

@@ -1,0 +1,121 @@
+"""TPU throughput sweep for the bench GPT config.
+
+Runs each (batch, seq, flash, flash-block, remat) variant in a bounded
+subprocess (a Mosaic failure or OOM costs one variant, not the sweep) and
+prints a ranked table. Use on the real chip to pick the headline bench
+config; timing uses the same host-read fence as bench.py (block_until_ready
+is a no-op on the axon platform).
+
+  python tools/tpu_tune.py            # full sweep
+  python tools/tpu_tune.py --quick    # 3 variants
+"""
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child(cfg):
+    sys.path.insert(0, REPO)
+    import jax
+    if os.environ.get('BENCH_FORCE_CPU') == '1':
+        # the axon sitecustomize force-sets jax_platforms at import; only a
+        # config update displaces it (see bench.py._force_cpu_if_requested)
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+
+    batch, seq = cfg['batch'], cfg['seq']
+    gcfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
+                         num_heads=16, max_seq_len=seq, dtype='bfloat16',
+                         remat=cfg['remat'], use_flash=cfg['flash'])
+    params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
+    opt_state = opt.functional_init(params)
+    step = gpt.make_train_step(gcfg, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 32768)
+    key, lr = jax.random.PRNGKey(2), jnp.asarray(2e-4)
+
+    fence_fn = jax.jit(lambda l, *ls: sum(
+        (x.ravel()[0].astype(jnp.float32) for x in ls), l.astype(jnp.float32)))
+
+    def fence(l, p, s):
+        return float(fence_fn(l, *jax.tree_util.tree_leaves((p, s))))
+
+    t0 = time.perf_counter()
+    loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
+    fence(loss, params, opt_state)
+    compile_s = time.perf_counter() - t0
+    iters = cfg.get('iters', 10)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
+    fence(loss, params, opt_state)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * iters / dt
+    print(json.dumps({'tokens_per_sec': tps, 'n_params': n_params,
+                      'compile_s': compile_s, 'step_ms': dt / iters * 1e3,
+                      'loss': float(loss)}))
+
+
+def main():
+    quick = '--quick' in sys.argv
+    variants = []
+    for batch, seq in ((8, 1024), (16, 1024), (32, 1024), (4, 2048), (8, 2048)):
+        variants.append(dict(batch=batch, seq=seq, flash=True, remat=True))
+    variants += [
+        dict(batch=8, seq=1024, flash=True, remat=False),
+        dict(batch=16, seq=1024, flash=True, remat=False),
+        dict(batch=8, seq=1024, flash=False, remat=True),
+        dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=256),
+        dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=512),
+        dict(batch=8, seq=1024, flash=True, remat=True, bq=128, bk=128),
+    ]
+    if quick:
+        variants = variants[:3]
+    results = []
+    for cfg in variants:
+        env = dict(os.environ)
+        if cfg.get('bq'):
+            env['PADDLE_TPU_FLASH_BQ'] = str(cfg['bq'])
+            env['PADDLE_TPU_FLASH_BK'] = str(cfg['bk'])
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), '--child',
+                 json.dumps(cfg)],
+                capture_output=True, text=True, timeout=1200, env=env)
+        except subprocess.TimeoutExpired:
+            print(f'{cfg}: TIMEOUT', flush=True)
+            continue
+        line = None
+        for ln in reversed((p.stdout or '').strip().splitlines()):
+            try:
+                line = json.loads(ln)
+                break
+            except ValueError:
+                continue
+        if p.returncode or line is None:
+            tail = (p.stderr or '').strip()[-400:]
+            print(f'{cfg}: FAILED rc={p.returncode}: {tail}', flush=True)
+            continue
+        line['cfg'] = cfg
+        results.append(line)
+        mfu = 6.0 * line['n_params'] * line['tokens_per_sec'] / 197e12
+        print(f"{cfg}: {line['tokens_per_sec']:,.0f} tok/s  "
+              f"step={line['step_ms']:.1f}ms  mfu(v5e)={mfu:.1%}  "
+              f"compile={line['compile_s']:.0f}s", flush=True)
+    results.sort(key=lambda r: -r['tokens_per_sec'])
+    print('\nBEST:', json.dumps(results[0]) if results else 'none')
+
+
+if __name__ == '__main__':
+    if len(sys.argv) > 2 and sys.argv[1] == '--child':
+        child(json.loads(sys.argv[2]))
+    else:
+        main()
