@@ -73,6 +73,7 @@ def __getattr__(name):
     import importlib
     if name in ('distributed', 'vision', 'text', 'distribution', 'inference',
                 'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler',
-                'hub', 'onnx', 'parallel', 'fluid', 'dataset', 'reader'):
+                'hub', 'onnx', 'parallel', 'fluid', 'dataset', 'reader',
+                'sparsity', 'quantization'):
         return importlib.import_module(f'.{name}', __name__)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -80,8 +80,15 @@ class _mixed_precision_ns:
     decorate = staticmethod(_MixedPrecisionOptimizer)
 
 
+class _slim_ns:
+    """fluid.contrib.slim.quantization — the 2.1 quantization home."""
+    from .. import quantization
+
+
 class contrib:
     """fluid.contrib shim: the 2.1 home of ASP sparsity (reference:
-    fluid/contrib/sparsity) and mixed-precision training."""
+    fluid/contrib/sparsity), quantization (slim), and mixed-precision
+    training."""
     from .. import sparsity
     mixed_precision = _mixed_precision_ns
+    slim = _slim_ns

@@ -55,50 +55,92 @@ class FakeQuantAbsMax(Layer):
         return fake_quantize_abs_max(x, self.bits)
 
 
-class QuantizedLinear(Layer):
-    """Linear with fake-quantized weights+activations (QAT)."""
+class _QuantWrapperBase(Layer):
+    """Shared QAT wrapper: weight fake-quant (per-tensor or per-channel
+    abs_max) + activation fake-quant (per-batch abs_max or moving-average
+    observer kept in a buffer, reference
+    fluid/contrib/slim/quantization/imperative/qat.py semantics)."""
 
-    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8):
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type='channel_wise_abs_max',
+                 activation_quantize_type='abs_max', moving_rate=0.9,
+                 observe_only=False):
         super().__init__()
+        from ..core.tensor import Tensor
         self.inner = layer
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self._w_type = weight_quantize_type
+        self._a_type = activation_quantize_type
+        self._rate = moving_rate
+        self._observe_only = observe_only   # PTQ calibration: collect scales,
+        #                                     pass activations through unquantized
+        if activation_quantize_type == 'moving_average_abs_max' or observe_only:
+            self.register_buffer('_act_scale',
+                                 Tensor(jnp.zeros((), jnp.float32)))
+
+    def _quant_act(self, x):
+        if self._a_type == 'moving_average_abs_max' or self._observe_only:
+            if self.training or self._observe_only:
+                cur = x.abs().max()
+                old = self._act_scale._value
+                # first observation seeds the scale instead of averaging
+                # against the zero init
+                new = jnp.where(old > 0,
+                                self._rate * old + (1 - self._rate) * cur._value,
+                                cur._value)
+                self._act_scale._replace_value(new.astype(jnp.float32))
+            if self._observe_only:
+                return x
+            return fake_quantize_moving_average_abs_max(
+                x, self._act_scale._value, self.activation_bits)
+        return fake_quantize_abs_max(x, self.activation_bits)
+
+    def _quant_weight(self, w, channel_axis):
+        if self._observe_only:
+            return w
+        if self._w_type == 'abs_max':
+            return fake_quantize_abs_max(w, self.weight_bits)
+        return fake_channel_wise_quantize_abs_max(w, self.weight_bits,
+                                                  axis=channel_axis)
+
+
+class QuantizedLinear(_QuantWrapperBase):
+    """Linear with fake-quantized weights+activations (QAT)."""
 
     def forward(self, x):
         from . import functional as F
-        xq = fake_quantize_abs_max(x, self.activation_bits)
-        wq = fake_channel_wise_quantize_abs_max(self.inner.weight,
-                                                self.weight_bits, axis=1)
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self.inner.weight, channel_axis=1)
         return F.linear(xq, wq, self.inner.bias)
 
 
-class QuantizedConv2D(Layer):
-    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8):
-        super().__init__()
-        self.inner = layer
-        self.weight_bits = weight_bits
-        self.activation_bits = activation_bits
-
+class QuantizedConv2D(_QuantWrapperBase):
     def forward(self, x):
         from . import functional as F
-        xq = fake_quantize_abs_max(x, self.activation_bits)
-        wq = fake_channel_wise_quantize_abs_max(self.inner.weight,
-                                                self.weight_bits, axis=0)
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self.inner.weight, channel_axis=0)
         return F.conv2d(xq, wq, self.inner.bias,
                         self.inner._stride, self.inner._padding,
                         self.inner._dilation, self.inner._groups,
                         self.inner._data_format)
 
 
-def quantize_model(model, weight_bits=8, activation_bits=8):
-    """Swap Linear/Conv2D sublayers for QAT-wrapped versions in place."""
+def quantize_model(model, weight_bits=8, activation_bits=8,
+                   layer_types=(Linear, Conv2D), **quant_kw):
+    """Swap quantizable sublayers for QAT-wrapped versions in place.
+    Already-wrapped layers are left alone, so a second pass (or PTQ after
+    QAT) never double-wraps."""
     for name, sub in list(model._sub_layers.items()):
-        if isinstance(sub, Linear):
-            model._sub_layers[name] = QuantizedLinear(sub, weight_bits,
-                                                      activation_bits)
-        elif isinstance(sub, Conv2D):
-            model._sub_layers[name] = QuantizedConv2D(sub, weight_bits,
-                                                      activation_bits)
+        if isinstance(sub, _QuantWrapperBase):
+            continue
+        if isinstance(sub, layer_types) and isinstance(sub, Linear):
+            model._sub_layers[name] = QuantizedLinear(
+                sub, weight_bits, activation_bits, **quant_kw)
+        elif isinstance(sub, layer_types) and isinstance(sub, Conv2D):
+            model._sub_layers[name] = QuantizedConv2D(
+                sub, weight_bits, activation_bits, **quant_kw)
         else:
-            quantize_model(sub, weight_bits, activation_bits)
+            quantize_model(sub, weight_bits, activation_bits,
+                           layer_types=layer_types, **quant_kw)
     return model

@@ -1,0 +1,143 @@
+"""Quantization toolkit: dygraph QAT and post-training calibration.
+
+Reference: python/paddle/fluid/contrib/slim/quantization — the 2.1 user
+entry points are ImperativeQuantAware (dygraph quant-aware training) and
+PostTrainingQuantization (static calibration). TPU-native redesign: fake
+quant is a straight-through estimator in jnp (nn/quant.py) that traces into
+the SAME fused XLA train step as everything else; serving keeps simulated
+int8 numerics in the exported program (XLA lowers pre-quantized weights to
+native int8 matmuls where profitable). The static-graph calibration passes
+(Quant*Pass, mkldnn rewrites) are N/A by design — there is no separate
+inference graph to rewrite; see MIGRATING.md.
+"""
+import numpy as np
+
+from ..nn import quant as _q
+from ..nn.layer_base import Layer
+
+__all__ = ['ImperativeQuantAware', 'PostTrainingQuantization',
+           'quant_post_dynamic']
+
+
+class ImperativeQuantAware:
+    """Dygraph quantization-aware training.
+
+    Reference: fluid/contrib/slim/quantization/imperative/qat.py:40. Usage::
+
+        quanter = ImperativeQuantAware()
+        quanter.quantize(model)           # in-place QAT wrappers
+        ... train as usual ...
+        quanter.save_quantized_model(model, path, input_spec=[...])
+    """
+
+    def __init__(self, quantizable_layer_type=('Conv2D', 'Linear'),
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_preprocess_layer=None, act_preprocess_layer=None,
+                 weight_quantize_layer=None, act_quantize_layer=None):
+        if weight_quantize_type not in ('abs_max', 'channel_wise_abs_max'):
+            raise ValueError(f'weight_quantize_type {weight_quantize_type!r} '
+                             "not in ('abs_max', 'channel_wise_abs_max')")
+        if activation_quantize_type not in ('abs_max',
+                                            'moving_average_abs_max'):
+            raise ValueError(
+                f'activation_quantize_type {activation_quantize_type!r} '
+                "not in ('abs_max', 'moving_average_abs_max')")
+        self._types = tuple(quantizable_layer_type)
+        self._kw = dict(weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type,
+                        moving_rate=moving_rate)
+        self._wb = weight_bits
+        self._ab = activation_bits
+
+    def quantize(self, model):
+        """Swap quantizable sublayers for QAT wrappers in place."""
+        from ..nn.layer_common import Linear
+        from ..nn.layer_conv import Conv2D
+        typemap = {'Linear': Linear, 'Conv2D': Conv2D}
+        want = tuple(typemap[t] for t in self._types if t in typemap)
+        return _q.quantize_model(model, self._wb, self._ab,
+                                 layer_types=want, **self._kw)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        """Export the QAT model through jit.save — the fake-quant ops are
+        traced into the serialized program, so the Predictor serves the
+        quantized numerics."""
+        was = layer.training
+        layer.eval()
+        try:
+            from ..jit import save
+            save(layer, path, input_spec=input_spec, **config)
+        finally:
+            if was:
+                layer.train()
+
+
+def quant_post_dynamic(model, sample_inputs=None, batch_nums=8,
+                       weight_bits=8, activation_bits=8,
+                       weight_quantize_type='channel_wise_abs_max',
+                       moving_rate=0.9):
+    """Post-training quantization for a dygraph Layer.
+
+    Calibration-based (reference: slim PostTrainingQuantization, redesigned
+    for the dygraph/TPU stack): wraps quantizable layers in OBSERVE mode,
+    feeds ``sample_inputs`` (an iterable of model inputs) to collect
+    moving-average activation scales, then flips the wrappers to quantized
+    eval. Returns the model.
+    """
+    _q.quantize_model(model, weight_bits, activation_bits,
+                      weight_quantize_type=weight_quantize_type,
+                      activation_quantize_type='moving_average_abs_max',
+                      moving_rate=moving_rate, observe_only=True)
+    model.eval()
+    seen = 0
+    if sample_inputs is not None:
+        for i, batch in enumerate(sample_inputs):
+            if i >= batch_nums:
+                break
+            model(*batch if isinstance(batch, (tuple, list)) else (batch,))
+            seen += 1
+    if seen == 0:
+        raise ValueError(
+            'quant_post_dynamic: no calibration batches were consumed — '
+            'activation scales would stay at 0 and quantized outputs would '
+            'collapse to ~0. Pass sample_inputs (an iterable of model input '
+            'batches).')
+    # calibration done: flip observers into quantizing mode
+    for sub in model.sublayers(include_self=True):
+        if isinstance(sub, _q._QuantWrapperBase):
+            sub._observe_only = False
+    return model
+
+
+class PostTrainingQuantization:
+    """Thin object form over quant_post_dynamic for API familiarity
+    (reference: slim/quantization/post_training_quantization.py — there
+    driven by an Executor over a static program; here a dygraph Layer)."""
+
+    def __init__(self, model, sample_generator=None, batch_nums=8,
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type='channel_wise_abs_max',
+                 moving_rate=0.9, **kw):
+        if kw:
+            raise TypeError(
+                f'PostTrainingQuantization: unsupported arguments {sorted(kw)}'
+                ' — the static-graph knobs (executor, model_dir, mkldnn '
+                'passes) do not exist in the dygraph/TPU stack, see '
+                'MIGRATING.md')
+        self._model = model
+        self._gen = sample_generator
+        self._args = (batch_nums, weight_bits, activation_bits,
+                      weight_quantize_type, moving_rate)
+
+    def quantize(self):
+        bn, wb, ab, wt, mr = self._args
+        return quant_post_dynamic(self._model, self._gen, batch_nums=bn,
+                                  weight_bits=wb, activation_bits=ab,
+                                  weight_quantize_type=wt, moving_rate=mr)
+
+    def save_quantized_model(self, save_model_path, input_spec=None):
+        from ..jit import save
+        self._model.eval()
+        save(self._model, save_model_path, input_spec=input_spec)

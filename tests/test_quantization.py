@@ -1,0 +1,94 @@
+"""Quantization: dygraph QAT (ImperativeQuantAware) and post-training
+calibration. Reference intent:
+fluid/contrib/slim/tests/test_imperative_qat.py — quantize, train, export,
+and the quantized model still learns / serves.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     PostTrainingQuantization,
+                                     quant_post_dynamic)
+
+
+def _data(n=64, d=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype('float32')
+    y = (x @ rng.randn(d, classes)).argmax(1).astype('int64')
+    return x, y
+
+
+def test_qat_trains_and_stays_close_to_fp32():
+    x, y = _data()
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    quanter = ImperativeQuantAware(weight_quantize_type='channel_wise_abs_max')
+    quanter.quantize(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    losses = []
+    for _ in range(10):
+        loss = F.cross_entropy(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # moving-average act observers populated
+    scales = {k: float(v._value) for k, v in net.named_buffers()
+              if k.endswith('_act_scale')}
+    assert scales and all(s > 0 for s in scales.values())
+    # int8 simulation stays within a reasonable band of the fp32 layer
+    net.eval()
+    q_out = net(paddle.to_tensor(x)).numpy()
+    fp = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    for (qn, qp), (fn_, fpp) in zip(
+            [(n_, p) for n_, p in net.named_parameters()],
+            [(n_, p) for n_, p in fp.named_parameters()]):
+        fpp._replace_value(qp._value)
+    fp.eval()
+    fp_out = fp(paddle.to_tensor(x)).numpy()
+    rel = np.abs(q_out - fp_out).max() / (np.abs(fp_out).max() + 1e-6)
+    assert rel < 0.1          # 8-bit fake quant: small simulated error
+
+
+def test_qat_export_and_serve(tmp_path):
+    x, _ = _data(n=8)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    quanter = ImperativeQuantAware(activation_quantize_type='abs_max')
+    quanter.quantize(net)
+    net.eval()
+    ref = net(paddle.to_tensor(x)).numpy()
+    path = os.path.join(str(tmp_path), 'qat')
+    quanter.save_quantized_model(
+        net, path,
+        input_spec=[paddle.static.InputSpec([None, 16], 'float32')])
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(path + '.pdmodel'))
+    out = np.asarray(pred.run([x])[0])
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_post_training_quantization():
+    x, _ = _data(n=32)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    fp32_out = None
+    net.eval()
+    fp32_out = net(paddle.to_tensor(x)).numpy()
+    calib = [(paddle.to_tensor(x[i:i + 8]),) for i in range(0, 32, 8)]
+    ptq = PostTrainingQuantization(net, sample_generator=calib, batch_nums=4)
+    ptq.quantize()
+    q_out = net(paddle.to_tensor(x)).numpy()
+    rel = np.abs(q_out - fp32_out).max() / (np.abs(fp32_out).max() + 1e-6)
+    assert 0 < rel < 0.1      # quantized but close
+
+
+def test_invalid_quant_types_raise():
+    with pytest.raises(ValueError):
+        ImperativeQuantAware(weight_quantize_type='nope')
+    with pytest.raises(ValueError):
+        ImperativeQuantAware(activation_quantize_type='nope')
