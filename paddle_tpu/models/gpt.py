@@ -136,7 +136,13 @@ def _layer_norm(x, g, b, eps=1e-5):
 def _attention(q, k, v, config, mesh=None):
     """q/k/v: [B, S, H, D]."""
     if config.sp > 1:
-        from ..parallel.ring_attention import ring_attention
+        from ..parallel.ring_attention import (ring_attention,
+                                               ring_flash_available,
+                                               ring_flash_attention)
+        if config.use_flash and ring_flash_available(q):
+            # pallas kernels per ring pair: no S_local x S_local scores in
+            # HBM, forward or backward
+            return ring_flash_attention(q, k, v, axis_name='sp', causal=True)
         return ring_attention(q, k, v, axis_name='sp', causal=True)
     if config.use_flash:
         try:

@@ -279,15 +279,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, out, lse, g, causal):
-    """Flash backward via the two-kernel pallas split; fp32 accumulation."""
-    bh, s, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    # delta_i = sum_d o_i * do_i — cheap XLA elementwise; lane-broadcast so
-    # the kernels load 2-D [BQ, LANES] tiles (same trick as the fwd lse)
+def bwd_broadcasts(out, lse, g):
+    """delta_i = sum_d o_i * do_i plus the lane-broadcast [BH,S,LANES] forms
+    of lse/delta the backward kernels load as 2-D tiles. Split out so a ring
+    caller can compute them ONCE and reuse across every ring hop."""
+    bh, s, _ = out.shape
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), -1)
     lse_b = jnp.broadcast_to(lse[:, :, None], (bh, s, _LANES))
     dta_b = jnp.broadcast_to(delta[:, :, None], (bh, s, _LANES))
+    return lse_b, dta_b
+
+
+def _bwd_pallas(q, k, v, out, lse, g, causal):
+    """Flash backward via the two-kernel pallas split; fp32 accumulation."""
+    lse_b, dta_b = bwd_broadcasts(out, lse, g)
+    return _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal)
+
+
+def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal):
+    """Backward kernels with the lse/delta broadcasts precomputed."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
 
     full = lambda b, i: (b, _np.int32(0), _np.int32(0))
     blk = lambda b, i: (b, i, _np.int32(0))

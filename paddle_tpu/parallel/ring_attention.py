@@ -74,6 +74,153 @@ def ring_attention(q, k, v, axis_name='sp', causal=True):
     return out.astype(q.dtype)
 
 
+# --------------------------------------------------------------------------
+# Ring FLASH attention: the ring schedule above, with every block pair
+# computed by the pallas flash kernels — no S_local x S_local score matrix
+# in HBM, in the forward OR the backward. Exact softmax over the full
+# sequence; grads exact (the backward re-runs each pair's tiled kernels
+# against the GLOBAL log-sum-exp, the standard ring-flash-attention split).
+# --------------------------------------------------------------------------
+
+def ring_flash_available(q, axis_name='sp'):
+    """The pallas kernels must tile the LOCAL sequence shard."""
+    from ..ops.flash_attention import flash_attention_available
+    return flash_attention_available(q, q, q, None)
+
+
+def _bhsd(x):
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _unbhsd(x, B, H):
+    BH, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal):
+    """-> (out [BH,S,D] in q.dtype, lse [BH,S] f32). Layout: kernel-major."""
+    from ..ops.flash_attention import _flash_fwd
+    sp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    qr, kr, vr = _bhsd(q), _bhsd(k), _bhsd(v)
+
+    def skip(_kv):
+        return (jnp.zeros(qr.shape, jnp.float32),
+                jnp.full((B * H, S), -jnp.inf, jnp.float32))
+
+    def off_diag(kv):
+        o, lse = _flash_fwd(qr, kv[0], kv[1], False)
+        return o.astype(jnp.float32), lse
+
+    def diag(kv):
+        o, lse = _flash_fwd(qr, kv[0], kv[1], True)
+        return o.astype(jnp.float32), lse
+
+    def body(carry, _):
+        o_acc, lse_acc, k_cur, v_cur, kv_rank = carry
+        if causal:
+            # 0: future block (masked out entirely), 1: past block (dense),
+            # 2: diagonal block (causal within the pair)
+            branch = jnp.where(kv_rank > idx, 0,
+                               jnp.where(kv_rank == idx, 2, 1))
+        else:
+            branch = jnp.int32(1)
+        o_b, lse_b = jax.lax.switch(branch, [skip, off_diag, diag],
+                                    (k_cur, v_cur))
+        # log-sum-exp merge of two softmax-normalized partials
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_a = jnp.exp(lse_acc - lse_new)[..., None]
+        w_b = jnp.exp(lse_b - lse_new)[..., None]
+        o_acc = o_acc * w_a + o_b * w_b
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, lse_new, k_nxt, v_nxt, (kv_rank - 1) % sp), None
+
+    o0 = jnp.zeros(qr.shape, jnp.float32)
+    lse0 = jnp.full((B * H, S), -jnp.inf, jnp.float32)
+    (o, lse, _, _, _), _ = jax.lax.scan(
+        body, (o0, lse0, kr, vr, idx), None, length=sp)
+    return o.astype(q.dtype), lse
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention(q, k, v, axis_name='sp', causal=True):
+    """q/k/v: [B, S_local, H, D] inside shard_map over ``axis_name``."""
+    B, _, H, _ = q.shape
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return _unbhsd(out, B, H)
+
+
+def _rf_f(q, k, v, axis_name, causal):
+    B, _, H, _ = q.shape
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return _unbhsd(out, B, H), (q, k, v, out, lse)
+
+
+def _rf_b(axis_name, causal, res, g):
+    from ..ops.flash_attention import _bwd_pallas_pre, bwd_broadcasts
+    q, k, v, out, lse = res            # out [BH,S,D] dtype q, lse [BH,S] f32
+    sp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    qr, kr, vr, gr = _bhsd(q), _bhsd(k), _bhsd(v), _bhsd(g.astype(q.dtype))
+    # global delta/lse lane-broadcasts depend only on (out, g): compute ONCE,
+    # reuse on every ring hop
+    lse_b, dta_b = bwd_broadcasts(out, lse, gr)
+
+    def skip(kv):
+        z = jnp.zeros(qr.shape, jnp.float32)
+        return z, z, z
+
+    def pair(kv, diag):
+        # the kernels recompute p = exp(s - GLOBAL lse) with the global
+        # delta, so each pair's tiled kernels emit exactly its
+        # contribution to dq / dk / dv
+        dq, dk, dv = _bwd_pallas_pre(qr, kv[0], kv[1], gr, lse_b, dta_b,
+                                     diag)
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32))
+
+    def body(carry, _):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur, kv_rank = carry
+        if causal:
+            branch = jnp.where(kv_rank > idx, 0,
+                               jnp.where(kv_rank == idx, 2, 1))
+        else:
+            branch = jnp.int32(1)
+        dq_b, dk_b, dv_b = jax.lax.switch(
+            branch, [skip, _partial(pair, diag=False),
+                     _partial(pair, diag=True)], (k_cur, v_cur))
+        dq_acc = dq_acc + dq_b
+        dk_cur = dk_cur + dk_b
+        dv_cur = dv_cur + dv_b
+        # k/v and THEIR grad accumulators rotate together: after sp hops
+        # every block is home again carrying contributions from all ranks
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq_acc, k_nxt, v_nxt, dk_nxt, dv_nxt,
+                (kv_rank - 1) % sp), None
+
+    z = jnp.zeros(qr.shape, jnp.float32)
+    (dq, _, _, dk, dv, _), _ = jax.lax.scan(
+        body, (z, kr, vr, z, z, idx), None, length=sp)
+    return (_unbhsd(dq.astype(q.dtype), B, H),
+            _unbhsd(dk.astype(k.dtype), B, H),
+            _unbhsd(dv.astype(v.dtype), B, H))
+
+
+ring_flash_attention.defvjp(_rf_f, _rf_b)
+
+
 def sequence_parallel_attention(q, k, v, mesh, causal=True):
     """shard_map wrapper: q/k/v are [B, S, H, D] global arrays; runs ring
     attention with S sharded over the mesh 'sp' axis."""

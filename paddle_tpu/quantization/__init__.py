@@ -44,6 +44,21 @@ class ImperativeQuantAware:
             raise ValueError(
                 f'activation_quantize_type {activation_quantize_type!r} '
                 "not in ('abs_max', 'moving_average_abs_max')")
+        unknown = [t for t in quantizable_layer_type
+                   if t not in ('Linear', 'Conv2D')]
+        if unknown:
+            raise ValueError(
+                f'quantizable_layer_type {unknown} not supported — this '
+                "stack quantizes ('Linear', 'Conv2D')")
+        for name, val in (('weight_preprocess_layer', weight_preprocess_layer),
+                          ('act_preprocess_layer', act_preprocess_layer),
+                          ('weight_quantize_layer', weight_quantize_layer),
+                          ('act_quantize_layer', act_quantize_layer)):
+            if val is not None:
+                raise TypeError(
+                    f'{name} is not supported — custom quantizer layers '
+                    'would be silently ignored; use the built-in abs_max / '
+                    'moving_average_abs_max observers')
         self._types = tuple(quantizable_layer_type)
         self._kw = dict(weight_quantize_type=weight_quantize_type,
                         activation_quantize_type=activation_quantize_type,
