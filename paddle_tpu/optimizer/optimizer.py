@@ -115,6 +115,15 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..utils import misc
+        if misc.in_static_mode():
+            # static-graph semantics: minimize APPENDS the backward+update
+            # program (reference: backward ops on the ProgramDesc); the
+            # Executor differentiates the recorded loss lineage and applies
+            # this optimizer on every run() — see static.Executor._compile
+            from ..static import default_main_program
+            default_main_program()._opt = (self, loss)
+            return None, [(p, None) for p in self._parameters]
         # Reference dygraph semantics (optimizer.py:786 in the reference):
         # backward() only COLLECTS grads already produced by loss.backward();
         # it never re-runs autograd — so `loss.backward(); opt.minimize(loss)`

@@ -48,7 +48,12 @@ class Program:
 
     def clone(self, for_test=False):
         import copy
-        return copy.copy(self)
+        c = copy.copy(self)
+        if for_test and hasattr(c, '_opt'):
+            # reference semantics: the test clone drops the backward +
+            # optimize ops — running it must never update parameters
+            del c._opt
+        return c
 
 
 _default_main = Program()
@@ -103,23 +108,92 @@ class Executor:
         program = program or default_main_program()
         fetch_list = fetch_list or []
         feed_names = tuple(sorted(feed.keys()))
-        key = (id(program), tuple(id(f) for f in fetch_list), feed_names)
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = self._compile(fetch_list, feed_names)
-            self._compiled[key] = fn
-        vals = fn(*[jnp.asarray(np.asarray(feed[n])) for n in feed_names])
+        opt_rec = getattr(program, '_opt', None)
+        # the optimizer/loss identities are part of the key: re-minimizing
+        # the same Program must not reuse a train fn differentiating the
+        # old objective
+        key = (id(program), tuple(id(f) for f in fetch_list), feed_names,
+               (id(opt_rec[0]), id(opt_rec[1])) if opt_rec else None)
+        entry = self._compiled.get(key)
+        if entry is None:
+            entry = self._compile(fetch_list, feed_names, opt_rec)
+            self._compiled[key] = entry
+        fn, leaves, params = entry
+        feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        leaf_vals = [t._value for t in leaves]
+        if params is not None:
+            # training program: one jitted pass computes fetches AND the
+            # loss grads wrt the program's parameters (the reference's
+            # backward+optimize ops appended by minimize); the optimizer's
+            # fused eager step applies them
+            opt, _ = opt_rec
+            vals, grads = fn(feed_vals, leaf_vals,
+                             [p._value for p in params])
+            if not opt._parameters:
+                # 1.x-style minimize with no parameter list: adopt the
+                # lineage-derived parameters so step() updates them
+                opt._parameters = params
+            for p, g in zip(params, grads):
+                p.grad = Tensor(g)
+            opt.step()
+            opt.clear_grad()
+        else:
+            vals = fn(feed_vals, leaf_vals)
         if return_numpy:
             return [np.asarray(v) for v in vals]
         return [Tensor(v) for v in vals]
 
-    def _compile(self, fetch_list, feed_names):
-        """Build one jitted function replaying each fetch's recorded op
-        lineage with placeholders substituted by the feed values."""
+    @staticmethod
+    def _collect_leaves(fetch_list, skip_ids=()):
+        """Non-placeholder tensors with no recorded lineage reachable from
+        the fetches (parameters, constants). They become INPUTS of the
+        compiled program so repeated runs see current values — baking them
+        in at trace time would freeze parameters at their first-run state."""
+        leaves, seen = [], set()
 
-        def replay_all(*feed_vals):
+        def walk(t):
+            if not isinstance(t, Tensor) or id(t) in seen:
+                return
+            seen.add(id(t))
+            if getattr(t, 'is_placeholder', False):
+                return
+            rp = getattr(t, '_replay', None)
+            if rp is None:
+                if id(t) not in skip_ids:
+                    leaves.append(t)
+                return
+            _, args, kwargs, _, _ = rp
+            for a in args:
+                for x in (a if isinstance(a, (list, tuple)) else (a,)):
+                    walk(x)
+        for f in fetch_list:
+            walk(f)
+        return leaves
+
+    def _compile(self, fetch_list, feed_names, opt_rec=None):
+        """Build one jitted function replaying each fetch's recorded op
+        lineage with placeholders substituted by the feed values and leaf
+        tensors (params/constants) passed as arguments. With ``opt_rec``
+        ((optimizer, loss)), the function additionally returns
+        d loss / d params — the static-mode training program."""
+        from ..nn.layer_base import Parameter
+        targets_all = (list(fetch_list) if opt_rec is None
+                       else list(fetch_list) + [opt_rec[1]])
+        all_leaves = self._collect_leaves(targets_all)
+        params = None
+        if opt_rec is not None:
+            # explicit parameter list if the optimizer has one, else the
+            # 1.x static idiom: every trainable Parameter in the lineage
+            params = ([p for p in opt_rec[0]._parameters if p.trainable] or
+                      [t for t in all_leaves
+                       if isinstance(t, Parameter) and t.trainable])
+        param_ids = {id(p) for p in (params or ())}
+        leaves = [t for t in all_leaves if id(t) not in param_ids]
+
+        def replay(feed_vals, leaf_vals, param_vals, targets):
             fmap = dict(zip(feed_names, feed_vals))
-            memo = {}
+            memo = {id(t): v for t, v in zip(leaves, leaf_vals)}
+            memo.update({id(p): v for p, v in zip(params or (), param_vals)})
 
             def value_of(t):
                 if not isinstance(t, Tensor):
@@ -140,12 +214,30 @@ class Executor:
                     out = fn(*vals, **kwargs)
                     v = out[idx] if is_seq else out
                 else:
-                    v = t._value
+                    v = t._value   # unreachable leaf guard
                 memo[k] = v
                 return v
-            return tuple(value_of(f) for f in fetch_list)
+            return tuple(value_of(f) for f in targets)
 
-        return jax.jit(replay_all)
+        if opt_rec is None:
+            def infer(feed_vals, leaf_vals):
+                return replay(feed_vals, leaf_vals, (), fetch_list)
+            return jax.jit(infer), leaves, None
+
+        opt, loss_t = opt_rec
+
+        def loss_and_fetches(param_vals, feed_vals, leaf_vals):
+            out = replay(feed_vals, leaf_vals, param_vals,
+                         [loss_t] + list(fetch_list))
+            return out[0], out[1:]
+
+        def train(feed_vals, leaf_vals, param_vals):
+            (_, fetches), grads = jax.value_and_grad(
+                loss_and_fetches, has_aux=True)(param_vals, feed_vals,
+                                                leaf_vals)
+            return fetches, grads
+
+        return jax.jit(train), leaves, params
 
 
 class scope_guard:

@@ -240,3 +240,89 @@ def test_fleet_dp_journey():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_static_training_journey():
+    """1.x static training: minimize(loss) inside program_guard appends the
+    backward+update program; every exe.run applies one optimizer step and
+    the fetched loss decreases (reference: Executor training workflow)."""
+    rng = np.random.RandomState(7)
+    xv = rng.randn(64, 8).astype('float32')
+    true_w = rng.randn(8, 1).astype('float32')
+    yv = xv @ true_w + 0.1 * rng.randn(64, 1).astype('float32')
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data('x', [None, 8], 'float32')
+            yt = paddle.static.data('y', [None, 1], 'float32')
+            pred = paddle.static.nn.fc(x, 1)
+            loss = ((pred - yt) * (pred - yt)).mean()
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(20):
+            lv, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    finally:
+        paddle.disable_static()
+    assert losses[-1] < 0.3 * losses[0]
+
+
+def test_static_inference_sees_updated_params():
+    """exe.run must reflect CURRENT parameter values, not the values at
+    first compile (staleness regression)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data('x', [None, 4], 'float32')
+            out = paddle.static.nn.fc(x, 2)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.ones((3, 4), dtype='float32')
+        r1, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+        # mutate the weight out-of-band (as a checkpoint restore would)
+        entry = next(v for k, v in exe._compiled.items() if k[1])
+        w = next(t for t in entry[1] if t._value.ndim == 2)
+        w._replace_value(w._value * 2.0)
+        r2, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+        assert not np.allclose(np.asarray(r1), np.asarray(r2))
+    finally:
+        paddle.disable_static()
+
+
+def test_static_clone_for_test_never_trains():
+    """clone(for_test=True) strips the optimize program — evaluation runs
+    must not move parameters (reference clone removes backward ops)."""
+    rng = np.random.RandomState(8)
+    xv = rng.randn(16, 4).astype('float32')
+    yv = rng.randn(16, 1).astype('float32')
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data('x', [None, 4], 'float32')
+            yt = paddle.static.data('y', [None, 1], 'float32')
+            pred = paddle.static.nn.fc(x, 1)
+            loss = ((pred - yt) * (pred - yt)).mean()
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        feed = {'x': xv, 'y': yv}
+        e1, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        e2, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        assert float(np.asarray(e1)) == float(np.asarray(e2))
+        # the TRAIN program does move the loss; a fetch-less run also steps
+        t1, = exe.run(main, feed=feed, fetch_list=[loss])
+        exe.run(main, feed=feed)                      # no fetch_list: legal
+        t2, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert float(np.asarray(t2)) < float(np.asarray(t1))
+    finally:
+        paddle.disable_static()
