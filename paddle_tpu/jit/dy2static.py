@@ -9,12 +9,16 @@ with a runtime dispatch that preserves plain-Python semantics whenever the
 condition is NOT a traced tensor, so eager behaviour is unchanged.
 
 Scope: tensor-conditioned ``if``/``else``, ``while``, ``for .. in
-range(...)`` (→ lax.cond / lax.while_loop), and ``and``/``or``/``not`` in
+range(...)`` (→ lax.cond / lax.while_loop), ``and``/``or``/``not`` in
 conditions (→ jnp.logical_* when traced, exact short-circuit otherwise),
-over bodies that only rebind local variables. Unsupported constructs
-(return/break escaping a tensor branch, attribute/subscript stores, a var
-bound in only one branch) raise Dy2StaticError with an actionable message
-instead of jax's TracerBoolConversionError.
+and ``break``/``continue`` in loops (lowered to flag variables + guards by
+a pre-pass — the reference's break_continue_transformer.py — so a
+tensor-conditioned break becomes loop-carried lax state; a ``for range``
+containing break lowers to its while-form first), over bodies that only
+rebind local variables. Still-unsupported constructs (``return`` escaping
+a tensor branch/loop, attribute/subscript stores, a var bound in only one
+branch) raise Dy2StaticError with an actionable message instead of jax's
+TracerBoolConversionError.
 """
 import ast
 import functools
@@ -230,6 +234,22 @@ def logical_not(x):
     return not _to_py_bool(x)
 
 
+def loop_cond(idx, stop, step):
+    """range-style continuation test handling negative steps, traced or
+    plain (used by the while-form a `for range` with break lowers to).
+    step == 0 matches range(): ValueError untraced, zero-trip traced (a
+    compiled graph cannot raise data-dependently)."""
+    ui, us, ust = _unwrap(idx), _unwrap(stop), _unwrap(step)
+    if _is_traced(ui) or _is_traced(us) or _is_traced(ust):
+        return jnp.where(jnp.asarray(ust) > 0,
+                         jnp.asarray(ui) < jnp.asarray(us),
+                         (jnp.asarray(ust) < 0) &
+                         (jnp.asarray(ui) > jnp.asarray(us)))
+    if ust == 0:
+        raise ValueError('range() arg 3 must not be zero')
+    return ui < us if ust > 0 else ui > us
+
+
 def unsupported_guard(pred, reason):
     """Evaluated on conditions we could not rewrite: plain Python passes
     through untouched; a traced condition gets an actionable error."""
@@ -338,7 +358,11 @@ def _mods_of(*stmt_lists):
         if info.escapes or info.complex_store:
             return None
         names |= info.assigned
-    return sorted(n for n in names if not n.startswith(_GEN_PREFIX))
+    # generated names are internal EXCEPT the break/continue flags and the
+    # while-form loop index — those are genuine loop-carried state
+    keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx')
+    return sorted(n for n in names
+                  if not n.startswith(_GEN_PREFIX) or n.startswith(keep))
 
 
 # --------------------------------------------------------------------------
@@ -439,6 +463,163 @@ def _rewrite_boolops(expr):
             return node
 
     return BoolRw().visit(expr)
+
+
+def _assign(name, value_node):
+    return ast.Assign(targets=[_store(name)], value=value_node)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """Lower ``break``/``continue`` into flag variables + guards BEFORE
+    control-flow conversion (reference:
+    dygraph_to_static/break_continue_transformer.py).
+
+    The rewrite preserves plain-Python semantics exactly — flags are
+    ordinary bools and the guards replicate the skipped control flow — so
+    when a flag is set under a TENSOR condition, the main transformer's
+    if/while conversion turns the flags into loop-carried lax values with
+    no further special-casing. A ``for range`` containing ``break`` lowers
+    to its while-form first so the flag can terminate the loop.
+    """
+
+    def __init__(self):
+        self._uid = 0
+        self.hoisted = []    # (name, default) pre-bound at function top so
+        #                      enclosing converted constructs always see the
+        #                      flags/index bound (no internal-name leaks)
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    @staticmethod
+    def _block_has_bc(stmts):
+        """break/continue binding to THIS loop (don't descend into inner
+        loops, which own their own break/continue)."""
+
+        def scan(body):
+            for st in body:
+                if isinstance(st, (ast.Break, ast.Continue)):
+                    return True
+                if isinstance(st, (ast.For, ast.While)):
+                    continue
+                for attr in ('body', 'orelse', 'finalbody'):
+                    if scan(getattr(st, attr, []) or []):
+                        return True
+            return False
+        return scan(stmts)
+
+    def _guard(self, stmts, fb, fc):
+        """Rewrite one block: break/continue become flag sets; everything
+        after a statement that MAY have set a flag runs under
+        ``if not (fb or fc)``. Returns (new_stmts, may_set_flag)."""
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(_assign(fb, _const(True)))
+                return out, True       # rest of block is unreachable
+            if isinstance(st, ast.Continue):
+                out.append(_assign(fc, _const(True)))
+                return out, True
+            found = False
+            if isinstance(st, (ast.For, ast.While)):
+                pass                   # inner loop owns its break/continue
+            elif isinstance(st, (ast.If, ast.With, ast.Try)):
+                for attr in ('body', 'orelse', 'finalbody'):
+                    blk = getattr(st, attr, None)
+                    if blk:
+                        new, f = self._guard(blk, fb, fc)
+                        setattr(st, attr, new)
+                        found = found or f
+            out.append(st)
+            if found:
+                rest, _ = self._guard(stmts[i + 1:], fb, fc)
+                if rest:
+                    cond = ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                        op=ast.Or(), values=[_load(fb), _load(fc)]))
+                    out.append(ast.If(test=cond, body=rest, orelse=[]))
+                return out, True
+        return out, False
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or not self._block_has_bc(node.body):
+            return node
+        uid = self._next()
+        fb, fc = f'{_GEN_PREFIX}brk{uid}', f'{_GEN_PREFIX}cont{uid}'
+        self.hoisted += [(fb, False), (fc, False)]
+        body, _ = self._guard(node.body, fb, fc)
+        node.body = [_assign(fc, _const(False))] + body
+        node.test = ast.BoolOp(op=ast.And(), values=[
+            node.test, ast.UnaryOp(op=ast.Not(), operand=_load(fb))])
+        # both flags pre-bound: they are loop-carried state for convert_while
+        return [_assign(fb, _const(False)), _assign(fc, _const(False)), node]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not self._block_has_bc(node.body):
+            return node
+        if not (_is_range_for(node) and isinstance(node.target, ast.Name)):
+            # plain-iterable for: continue lowers with guards alone (the
+            # iteration count is unchanged); break over a Python iterable
+            # keeps Python semantics untouched (a traced break condition
+            # then raises via the If conversion's unsupported_guard)
+            if not any(isinstance(s, ast.Break) for s in ast.walk(ast.Module(
+                    body=node.body, type_ignores=[]))):
+                uid = self._next()
+                fb, fc = f'{_GEN_PREFIX}brk{uid}', f'{_GEN_PREFIX}cont{uid}'
+                self.hoisted += [(fb, False), (fc, False)]
+                body, _ = self._guard(node.body, fb, fc)
+                node.body = ([_assign(fb, _const(False)),
+                              _assign(fc, _const(False))] + body)
+            return node
+        uid = self._next()
+        fb, fc = f'{_GEN_PREFIX}brk{uid}', f'{_GEN_PREFIX}cont{uid}'
+        idx = f'{_GEN_PREFIX}idx{uid}'
+        stopn, stepn = f'{_GEN_PREFIX}stop{uid}', f'{_GEN_PREFIX}step{uid}'
+        self.hoisted += [(fb, False), (fc, False), (idx, 0)]
+        a = node.iter.args
+        if len(a) == 1:
+            start, stop, step = _const(0), a[0], _const(1)
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], _const(1)
+        else:
+            start, stop, step = a
+        body, _ = self._guard(node.body, fb, fc)
+        loop = ast.While(
+            test=ast.BoolOp(op=ast.And(), values=[
+                _rt_call('loop_cond', [_load(idx), _load(stopn),
+                                       _load(stepn)]),
+                ast.UnaryOp(op=ast.Not(), operand=_load(fb))]),
+            body=[_assign(node.target.id, _load(idx)),
+                  _assign(idx, ast.BinOp(left=_load(idx), op=ast.Add(),
+                                         right=_load(stepn))),
+                  _assign(fc, _const(False))] + body,
+            orelse=[])
+        # pre-bind the loop target (= start) ONLY when it is unbound, so it
+        # is valid while_loop carry state without clobbering a prior
+        # binding on a zero-trip plain-Python loop; a zero-trip traced loop
+        # leaves it at start — the materialization any traced program needs
+        bind_now = _assign(node.target.id, _load(idx))
+        undef_attr = ast.Attribute(value=_load(_RT_NAME), attr='UNDEF',
+                                   ctx=ast.Load())
+        tgt_bind = ast.Try(
+            # bound-to-UNDEF counts as unbound: an enclosing converted
+            # construct's sentinel may have handed us the UNDEF marker
+            body=[ast.If(test=ast.Compare(left=_load(node.target.id),
+                                          ops=[ast.Is()],
+                                          comparators=[undef_attr]),
+                         body=[bind_now], orelse=[])],
+            handlers=[ast.ExceptHandler(type=_load('NameError'), name=None,
+                                        body=[bind_now])],
+            orelse=[], finalbody=[])
+        return [_assign(stopn, stop), _assign(stepn, step),
+                _assign(idx, start), tgt_bind,
+                _assign(fb, _const(False)), _assign(fc, _const(False)), loop]
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -606,6 +787,12 @@ def convert_control_flow(fn):
         return fn
     fdef.decorator_list = []           # avoid re-entering to_static on exec
     try:
+        bc = _BreakContinueTransformer()
+        bc.visit(fdef)
+        # hoist flag/index defaults to the function top: enclosing converted
+        # constructs then always see these generated names bound, so they
+        # never surface in a user-facing unbound-variable error
+        fdef.body = [_assign(n, _const(v)) for n, v in bc.hoisted] + fdef.body
         _ControlFlowTransformer().visit(fdef)
         ast.fix_missing_locations(tree)
         code = compile(tree, filename=f'<dy2static:{raw.__name__}>',
@@ -653,4 +840,5 @@ class _runtime_namespace:
     logical_and = staticmethod(logical_and)
     logical_or = staticmethod(logical_or)
     logical_not = staticmethod(logical_not)
+    loop_cond = staticmethod(loop_cond)
     unsupported_guard = staticmethod(unsupported_guard)

@@ -144,7 +144,7 @@ class Executor:
         return [Tensor(v) for v in vals]
 
     @staticmethod
-    def _collect_leaves(fetch_list, skip_ids=()):
+    def _collect_leaves(fetch_list):
         """Non-placeholder tensors with no recorded lineage reachable from
         the fetches (parameters, constants). They become INPUTS of the
         compiled program so repeated runs see current values — baking them
@@ -159,8 +159,7 @@ class Executor:
                 return
             rp = getattr(t, '_replay', None)
             if rp is None:
-                if id(t) not in skip_ids:
-                    leaves.append(t)
+                leaves.append(t)
                 return
             _, args, kwargs, _, _ = rp
             for a in args:

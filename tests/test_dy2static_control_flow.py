@@ -383,21 +383,34 @@ def test_zero_trip_for_keeps_prior_target_binding():
 
 
 def test_nonconvertible_traced_for_errors_clearly():
-    """break in a tensor-range for: actionable Dy2StaticError, not jax's
-    concretization error."""
+    """return inside a tensor-range for is still unconvertible: actionable
+    Dy2StaticError, not jax's concretization error. (break/continue now
+    CONVERT via the flag-lowering pre-pass — asserted below.)"""
     @paddle.jit.to_static
     def f(x, n):
         acc = x * 0
         for i in range(n):
             if int(0) == 0:
-                break
+                return acc
             acc = acc + x
         return acc
 
     n = paddle.to_tensor(np.asarray(3, dtype='int32'))
     with pytest.raises(Dy2StaticError) as ei:
         f(_t([1.0]), n)
-    assert 'break' in str(ei.value) or 'not convertible' in str(ei.value)
+    assert 'return' in str(ei.value) or 'not convertible' in str(ei.value)
+
+    @paddle.jit.to_static
+    def g(x, n):
+        acc = x * 0
+        for i in range(n):          # traced bound AND break: converts now
+            if i >= 2:
+                break
+            acc = acc + x
+        return acc
+
+    out = g(_t([1.0]), n)
+    assert float(np.asarray(out._value)[0]) == 2.0
 
 
 def test_plain_iterable_for_not_reexeced():
@@ -422,3 +435,159 @@ def test_traced_step_zero_terminates():
     s0 = paddle.to_tensor(np.asarray(0, dtype='int32'))
     # zero-trip, not an infinite compiled loop
     np.testing.assert_allclose(f(_t([1.0]), s0).numpy(), [0.0])
+
+
+# ---- break / continue (round 3: flag-lowering pre-pass) -------------------
+
+def test_while_true_tensor_break():
+    """The classic `while True: ... if cond: break` with a tensor condition
+    compiles to a lax.while_loop on the lowered break flag."""
+    @paddle.jit.to_static
+    def f(x, limit):
+        total = x * 0
+        i = x * 0
+        while True:
+            total = total + i
+            i = i + 1
+            if i >= limit:
+                break
+        return total
+
+    out = f(paddle.to_tensor(np.float32(0.0)),
+            paddle.to_tensor(np.float32(5.0)))
+    assert float(out) == float(sum(range(5)))
+
+
+def test_for_range_tensor_break():
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = x * 0
+        for i in range(100):
+            if acc >= n:
+                break
+            acc = acc + x
+        return acc
+
+    out = f(paddle.to_tensor(np.float32(2.0)),
+            paddle.to_tensor(np.float32(7.0)))
+    assert float(out) == 8.0
+
+
+def test_for_continue_python_and_tensor():
+    @paddle.jit.to_static
+    def f(x):
+        acc = x * 0
+        for i in range(6):
+            if i % 2 == 0:          # python condition
+                continue
+            acc = acc + i
+        return acc
+
+    assert float(f(paddle.to_tensor(np.float32(0.0)))) == 9.0
+
+    @paddle.jit.to_static
+    def g(x):
+        acc = x * 0
+        t = acc
+        for i in range(5):
+            t = acc + i
+            if t > 4:               # tensor condition
+                continue
+            acc = t
+        return acc
+
+    assert float(g(paddle.to_tensor(np.float32(0.0)))) == 3.0
+
+
+def test_break_matches_eager_semantics():
+    """Converted functions behave identically to the plain-Python original
+    across inputs (traced and untraced flag paths agree)."""
+    def raw(x, stop_at):
+        acc = x * 0
+        for i in range(10):
+            if i == 3:
+                continue
+            acc = acc + i
+            if acc >= stop_at:
+                break
+        return acc
+
+    conv = paddle.jit.to_static(raw)
+    for stop in (2.0, 7.0, 100.0):
+        got = float(conv(paddle.to_tensor(np.float32(0.0)),
+                         paddle.to_tensor(np.float32(stop))))
+        want = 0.0
+        for i in range(10):
+            if i == 3:
+                continue
+            want += i
+            if want >= stop:
+                break
+        assert got == want, (stop, got, want)
+
+
+def test_break_inside_tensor_branch():
+    """A break-loop inside a TENSOR if-branch: the generated break flags
+    must never leak into the enclosing construct's error surface — the
+    only constraint reported is the USER's one-branch-bound loop target,
+    and pre-binding it makes the construct convert."""
+    @paddle.jit.to_static
+    def f(flag, x):
+        acc = x * 0
+        if flag > 0:
+            for i in range(5):
+                if i == 2:
+                    break
+                acc = acc + 1
+        else:
+            acc = acc - 1
+        return acc
+
+    one = paddle.to_tensor(np.float32(1.0))
+    with pytest.raises(Dy2StaticError) as ei:
+        f(paddle.to_tensor(np.float32(1.0)), one)
+    assert "'i'" in str(ei.value)          # user var, not _pt_brk/_pt_cont
+    assert '_pt_' not in str(ei.value)
+
+    @paddle.jit.to_static
+    def g(flag, x):
+        acc = x * 0
+        i = 0
+        if flag > 0:
+            for i in range(5):
+                if i == 2:
+                    break
+                acc = acc + 1
+        else:
+            acc = acc - 1
+        return acc
+
+    assert float(g(paddle.to_tensor(np.float32(1.0)), one)) == 2.0
+    assert float(g(paddle.to_tensor(np.float32(-1.0)), one)) == -1.0
+
+
+def test_zero_step_range_matches_python():
+    @paddle.jit.to_static
+    def f(x):
+        acc = x * 0
+        for i in range(5, 0, 0):
+            if i > 100:
+                break
+            acc = acc + 1
+        return acc
+
+    with pytest.raises(ValueError):
+        f(paddle.to_tensor(np.float32(0.0)))
+
+
+def test_zero_trip_break_for_keeps_prior_target():
+    @paddle.jit.to_static
+    def f(x):
+        i = 99
+        for i in range(0):
+            if i > 3:
+                break
+            x = x + 1
+        return x * 0 + i
+
+    assert float(f(paddle.to_tensor(np.float32(0.0)))) == 99.0
