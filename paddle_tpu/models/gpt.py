@@ -160,6 +160,24 @@ def _attention(q, k, v, config, mesh=None):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
+def _block_qkv(bp, y, nh, hd, cdt):
+    """Fused QKV projection shared by the train block and the KV-cache
+    decode block. Head-major packing [q_i|k_i|v_i] per head: an 'mp' column
+    shard is then exactly that rank's heads (contiguous [Q|K|V] thirds
+    would hand each rank a mix of Q and K columns)."""
+    B, S, _ = y.shape
+    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    qkv = qkv.reshape(B, S, nh, 3, hd)
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
+def _block_mlp(bp, y, cdt):
+    """fc -> gelu -> out projection (bias added by the caller after the
+    mp all-reduce)."""
+    y = jax.nn.gelu(y @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt))
+    return y @ bp['out_w'].astype(cdt)
+
+
 def block_fn(bp, x, config, explicit_mp=False):
     """One transformer block. bp: this layer's params (no leading L dim).
     x: [B, S, H]. With ``explicit_mp`` (inside shard_map), qkv/fc weights are
@@ -177,12 +195,7 @@ def block_fn(bp, x, config, explicit_mp=False):
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     if mp > 1:
         y = f_identity(y, 'mp')
-    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
-    # head-major packing [q_i|k_i|v_i] per head: an 'mp' column shard is then
-    # exactly that rank's heads (contiguous [Q|K|V] thirds would hand each
-    # rank a mix of Q and K columns)
-    qkv = qkv.reshape(B, S, nh, 3, hd)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt)
     a = _attention(q, k, v, config).reshape(B, S, h // mp)
     a = a @ bp['proj_w'].astype(cdt)
     if mp > 1:
@@ -192,9 +205,7 @@ def block_fn(bp, x, config, explicit_mp=False):
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     if mp > 1:
         y = f_identity(y, 'mp')
-    y = y @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt)
-    y = jax.nn.gelu(y)
-    y = y @ bp['out_w'].astype(cdt)
+    y = _block_mlp(bp, y, cdt)
     if mp > 1:
         y = g_allreduce(y, 'mp')
     x = x + y + bp['out_b'].astype(cdt)
@@ -227,6 +238,103 @@ def loss_fn(params, tokens, targets, config: GPTConfig):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache autoregressive decoding (serving path)
+#
+# TPU-native design: the cache is pre-allocated at [L, B, S_max, H, Dh]
+# (static shapes — XLA compiles ONE prefill program and ONE decode-step
+# program), each step writes its k/v row via lax.dynamic_update_slice and
+# attends over the full cache with a position mask. Per-token cost is
+# O(S_max * d) instead of the O(S^2 * d) full-context recompute, and the
+# whole generate loop is a single lax.while-free python loop over ONE
+# compiled step (no per-length retracing).
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: GPTConfig, batch):
+    """-> {'k','v': [L, B, S_max, H, Dh] in the compute dtype}."""
+    cdt = jnp.dtype(config.dtype)
+    shape = (config.num_layers, batch, config.max_seq_len,
+             config.num_heads, config.head_dim)
+    return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
+
+
+def _cached_block(bp, x, k_cache, v_cache, pos, config):
+    """One block over a [B, T, H] slice starting at ``pos``; returns the
+    block output and the k/v caches with rows [pos, pos+T) filled.
+    Attention: q rows attend to cache positions <= their absolute index."""
+    cdt = jnp.dtype(config.dtype)
+    B, T, h = x.shape
+    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    S = k_cache.shape[1]
+    scale = 1.0 / math.sqrt(config.head_dim)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache) * scale      # [B,H,T,S]
+    q_pos = pos + jnp.arange(T)[:, None]                        # [T,1]
+    k_pos = jnp.arange(S)[None, :]                              # [1,S]
+    s = jnp.where((k_pos <= q_pos)[None, None], s.astype(jnp.float32),
+                  jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1).astype(cdt)
+    a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache).reshape(B, T, h)
+    x = x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt)
+    y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+    x = x + _block_mlp(bp, y, cdt) + bp['out_b'].astype(cdt)
+    return x, k_cache, v_cache
+
+
+def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
+                       last_only=False):
+    """Run [B, T] tokens whose absolute positions start at ``pos`` (a traced
+    scalar), reading/writing the KV cache. Returns (logits, cache) — logits
+    [B,T,V], or [B,1,V] with ``last_only`` (prefill skips the full-vocab
+    head matmul for all but the final position: at B=8, T0=1000, V=50304
+    that matmul and its ~1.6 GB logits tensor are pure waste).
+    T is the static block width: the prompt length at prefill, 1 per decode
+    step — each width compiles exactly once."""
+    cdt = jnp.dtype(config.dtype)
+    B, T = tokens.shape
+    ppos = pos + jnp.arange(T)
+    x = (jnp.take(params['wte'], tokens, axis=0)
+         + jnp.take(params['wpe'], ppos, axis=0)).astype(cdt)
+
+    def scan_body(carry, inp):
+        xx = carry
+        bp, kc, vc = inp
+        xx, kc, vc = _cached_block(bp, xx, kc, vc, pos, config)
+        return xx, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_body, x, (params['blocks'], cache['k'], cache['v']))
+    if last_only:
+        x = x[:, -1:]
+    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+    logits = x @ params['wte'].T.astype(cdt)
+    return logits, {'k': k_new, 'v': v_new}
+
+
+def make_decode_fns(config: GPTConfig):
+    """-> (prefill, step), both jitted with donated caches.
+
+    prefill(params, prompt [B,T], cache) -> (last_logits [B,V], cache)
+    step(params, tok [B], pos, cache)    -> (logits [B,V], cache)
+    """
+    @partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, prompt, cache):
+        logits, cache = forward_with_cache(params, prompt, cache,
+                                           jnp.int32(0), config,
+                                           last_only=True)
+        return logits[:, -1], cache
+
+    @partial(jax.jit, donate_argnums=(3,))
+    def step(params, tok, pos, cache):
+        logits, cache = forward_with_cache(params, tok[:, None], cache, pos,
+                                           config)
+        return logits[:, 0], cache
+
+    return prefill, step
 
 
 # ---------------------------------------------------------------------------
@@ -465,22 +573,64 @@ class GPTForCausalLM(Layer):
         return apply_op(pure, tokens, *plist)
 
     def generate(self, tokens, max_new_tokens=32, temperature=1.0, top_k=None):
-        """Greedy/temperature sampling (eager loop, jitted forward)."""
+        """KV-cache autoregressive sampling: one compiled prefill + one
+        compiled single-token decode step (O(S_max d) per token, no
+        per-length retracing — see make_decode_fns)."""
         from ..tensor.random import next_key
         cfg = self.config
         toks = tokens._value if isinstance(tokens, Tensor) else jnp.asarray(tokens)
         toks = toks.astype(jnp.int32)
-        fwd = jax.jit(lambda p, t: forward(p, t, cfg))
+        B, T0 = toks.shape
+        if T0 + max_new_tokens > cfg.max_seq_len:
+            # generation would outgrow the cache: sliding-window recompute
+            # preserves the pre-cache semantics (window of the last
+            # max_seq_len tokens conditions each step)
+            return self._generate_sliding(toks, max_new_tokens, temperature,
+                                          top_k)
+        params = self._params()
+        prefill, step = self._decode_fns()
+        cache = init_kv_cache(cfg, B)
+        logits, cache = prefill(params, toks, cache)
+
+        def sample(logits):
+            if temperature == 0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lg = logits.astype(jnp.float32) / temperature
+            if top_k:
+                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            return jax.random.categorical(next_key(), lg, axis=-1).astype(jnp.int32)
+
+        out = [toks]
+        for i in range(max_new_tokens):
+            nxt = sample(logits)
+            out.append(nxt[:, None])
+            if i + 1 < max_new_tokens:
+                logits, cache = step(params, nxt, jnp.int32(T0 + i), cache)
+        return Tensor(jnp.concatenate(out, axis=1))
+
+    def _decode_fns(self):
+        if getattr(self, '_decode_cache', None) is None:
+            self._decode_cache = make_decode_fns(self.config)
+        return self._decode_cache
+
+    def _generate_sliding(self, toks, max_new_tokens, temperature, top_k):
+        """Full-context recompute with a sliding window — the fallback when
+        T0 + max_new_tokens exceeds the KV cache (= max_seq_len)."""
+        from ..tensor.random import next_key
+        cfg = self.config
+        fwd = jax.jit(lambda p, t: forward(p, t, cfg)[:, -1])
         for _ in range(max_new_tokens):
             ctx = toks[:, -cfg.max_seq_len:]
-            logits = fwd(self._params(), ctx)[:, -1]
+            logits = fwd(self._params(), ctx)
             if temperature == 0:
                 nxt = jnp.argmax(logits, axis=-1)
             else:
-                logits = logits / temperature
+                lg = logits.astype(jnp.float32) / temperature
                 if top_k:
-                    kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                    logits = jnp.where(logits < kth, -jnp.inf, logits)
-                nxt = jax.random.categorical(next_key(), logits, axis=-1)
-            toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)], axis=1)
+                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                nxt = jax.random.categorical(next_key(), lg, axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)],
+                                   axis=1)
         return Tensor(toks)
