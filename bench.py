@@ -195,6 +195,38 @@ def _child_eager():
     print(json.dumps({'eager_ops_per_sec': 4 * n / dt}))
 
 
+def _child_decode():
+    """Autoregressive serving throughput: KV-cache decode steps/sec on the
+    bench GPT config (batch 8). Fenced by per-chunk host reads."""
+    _arm_watchdog(CONFIG_TIMEOUT_S)
+    import jax
+    _force_cpu_if_requested()
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_seq_len=1024, dtype='bfloat16',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prefill, step = gpt.make_decode_fns(cfg)
+    B, T0, N = 8, 128, 64
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
+                                cfg.vocab_size)
+    cache = gpt.init_kv_cache(cfg, B)
+    logits, cache = prefill(params, prompt, cache)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    # warm the step compile, then fence
+    logits, cache = step(params, tok, jnp.int32(T0), cache)
+    float(logits[0, 0])
+    t0 = time.perf_counter()
+    for i in range(1, N):
+        logits, cache = step(params, jnp.argmax(logits, -1).astype(jnp.int32),
+                             jnp.int32(T0 + i), cache)
+    float(logits[0, 0])                 # host read fences the chain
+    dt = time.perf_counter() - t0
+    print(json.dumps({'decode_tokens_per_sec': B * (N - 1) / dt}))
+
+
 def _child_predictor():
     """p50 latency of a served vision model (ResNet-18, batch 1) through the
     full jit.save -> Predictor serving path, mirroring Paddle-Inference."""
@@ -388,6 +420,14 @@ def main():
     else:
         print(f'eager microbench failed: {enote}', file=sys.stderr)
 
+    if platform != 'cpu':
+        dec, dnote = _run_child(['--child-decode'], CONFIG_TIMEOUT_S)
+        if dec is not None:
+            out['decode_tokens_per_sec'] = round(
+                dec['decode_tokens_per_sec'], 1)
+        else:
+            print(f'decode bench failed: {dnote}', file=sys.stderr)
+
     print(json.dumps(out))
     return 0
 
@@ -403,5 +443,7 @@ if __name__ == '__main__':
         _child_predictor()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-eager':
         _child_eager()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-decode':
+        _child_decode()
     else:
         sys.exit(main())

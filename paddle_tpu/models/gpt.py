@@ -315,6 +315,19 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     return logits, {'k': k_new, 'v': v_new}
 
 
+def _sample(logits, temperature, top_k):
+    """Greedy / temperature / top-k next-token draw — the ONE sampling rule
+    shared by the cache path and the sliding-window continuation."""
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    from ..tensor.random import next_key
+    lg = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return jax.random.categorical(next_key(), lg, axis=-1).astype(jnp.int32)
+
+
 def make_decode_fns(config: GPTConfig):
     """-> (prefill, step), both jitted with donated caches.
 
@@ -575,39 +588,32 @@ class GPTForCausalLM(Layer):
     def generate(self, tokens, max_new_tokens=32, temperature=1.0, top_k=None):
         """KV-cache autoregressive sampling: one compiled prefill + one
         compiled single-token decode step (O(S_max d) per token, no
-        per-length retracing — see make_decode_fns)."""
-        from ..tensor.random import next_key
+        per-length retracing — see make_decode_fns). Tokens past the
+        context window continue on the sliding-window recompute path, so
+        the cache is used for every token that fits it."""
         cfg = self.config
         toks = tokens._value if isinstance(tokens, Tensor) else jnp.asarray(tokens)
         toks = toks.astype(jnp.int32)
         B, T0 = toks.shape
-        if T0 + max_new_tokens > cfg.max_seq_len:
-            # generation would outgrow the cache: sliding-window recompute
-            # preserves the pre-cache semantics (window of the last
-            # max_seq_len tokens conditions each step)
-            return self._generate_sliding(toks, max_new_tokens, temperature,
-                                          top_k)
-        params = self._params()
-        prefill, step = self._decode_fns()
-        cache = init_kv_cache(cfg, B)
-        logits, cache = prefill(params, toks, cache)
-
-        def sample(logits):
-            if temperature == 0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            lg = logits.astype(jnp.float32) / temperature
-            if top_k:
-                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg < kth, -jnp.inf, lg)
-            return jax.random.categorical(next_key(), lg, axis=-1).astype(jnp.int32)
-
-        out = [toks]
-        for i in range(max_new_tokens):
-            nxt = sample(logits)
-            out.append(nxt[:, None])
-            if i + 1 < max_new_tokens:
-                logits, cache = step(params, nxt, jnp.int32(T0 + i), cache)
-        return Tensor(jnp.concatenate(out, axis=1))
+        n_cached = (min(max_new_tokens, cfg.max_seq_len - T0)
+                    if T0 < cfg.max_seq_len else 0)
+        if n_cached > 0:
+            params = self._params()
+            prefill, step = self._decode_fns()
+            cache = init_kv_cache(cfg, B)
+            logits, cache = prefill(params, toks, cache)
+            out = [toks]
+            for i in range(n_cached):
+                nxt = _sample(logits, temperature, top_k)
+                out.append(nxt[:, None])
+                if i + 1 < n_cached:
+                    logits, cache = step(params, nxt, jnp.int32(T0 + i),
+                                         cache)
+            toks = jnp.concatenate(out, axis=1)
+        rest = max_new_tokens - n_cached
+        if rest > 0:
+            return self._generate_sliding(toks, rest, temperature, top_k)
+        return Tensor(toks)
 
     def _decode_fns(self):
         if getattr(self, '_decode_cache', None) is None:
@@ -615,22 +621,13 @@ class GPTForCausalLM(Layer):
         return self._decode_cache
 
     def _generate_sliding(self, toks, max_new_tokens, temperature, top_k):
-        """Full-context recompute with a sliding window — the fallback when
-        T0 + max_new_tokens exceeds the KV cache (= max_seq_len)."""
-        from ..tensor.random import next_key
+        """Full-context recompute with a sliding window — the continuation
+        once generation outgrows the KV cache (= max_seq_len). Every window
+        is full-width here, so the jitted forward compiles once."""
         cfg = self.config
         fwd = jax.jit(lambda p, t: forward(p, t, cfg)[:, -1])
         for _ in range(max_new_tokens):
             ctx = toks[:, -cfg.max_seq_len:]
-            logits = fwd(self._params(), ctx)
-            if temperature == 0:
-                nxt = jnp.argmax(logits, axis=-1)
-            else:
-                lg = logits.astype(jnp.float32) / temperature
-                if top_k:
-                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                    lg = jnp.where(lg < kth, -jnp.inf, lg)
-                nxt = jax.random.categorical(next_key(), lg, axis=-1)
-            toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)],
-                                   axis=1)
+            nxt = _sample(fwd(self._params(), ctx), temperature, top_k)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         return Tensor(toks)
