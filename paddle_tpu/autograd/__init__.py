@@ -66,28 +66,25 @@ class PyLayer:
     def apply(cls, *args, **kwargs):
         from ..core.dispatch import apply_op
 
-        class _Ctx:
-            def save_for_backward(self, *ts):
-                self.saved = ts
-
-            @property
-            def saved_tensor(self):
-                return self.saved
-
-        ctx = _Ctx()
+        ctx = PyLayerContext()
         out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
         # Route through jax.custom_vjp for grad support
         tensors = [a for a in args if isinstance(a, Tensor)]
 
         @jax.custom_vjp
         def f(*vals):
-            return out._value if isinstance(out, Tensor) else out
+            vs = tuple(o._value if isinstance(o, Tensor) else o for o in outs)
+            return vs if multi else vs[0]
 
         def f_fwd(*vals):
             return f(*vals), None
 
         def f_bwd(res, g):
-            gs = cls.backward(ctx, Tensor(g))
+            grads_in = (tuple(Tensor(x) for x in g) if multi
+                        else (Tensor(g),))
+            gs = cls.backward(ctx, *grads_in)
             if isinstance(gs, Tensor):
                 gs = (gs,)
             return tuple(x._value if isinstance(x, Tensor) else x for x in gs)
@@ -107,12 +104,15 @@ def is_grad_enabled():
 
 
 class PyLayerContext:
-    """Context object passed to PyLayer.forward/backward."""
+    """Context object passed to PyLayer.forward/backward.
+
+    Reference: python/paddle/autograd/py_layer.py — ``saved_tensor()`` is a
+    METHOD there, so it is one here (a property broke ported user code
+    with \"'tuple' object is not callable\")."""
 
     def save_for_backward(self, *tensors):
         self.container = tensors
 
-    @property
     def saved_tensor(self):
         return self.container
 

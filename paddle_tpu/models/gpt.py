@@ -595,7 +595,10 @@ class GPTForCausalLM(Layer):
         toks = tokens._value if isinstance(tokens, Tensor) else jnp.asarray(tokens)
         toks = toks.astype(jnp.int32)
         B, T0 = toks.shape
-        n_cached = (min(max_new_tokens, cfg.max_seq_len - T0)
+        # +1: the final cached step runs at pos max_seq_len-1 (filling the
+        # last cache row) and its logits see the full window — identical
+        # conditioning to the sliding path's first step
+        n_cached = (min(max_new_tokens, cfg.max_seq_len - T0 + 1)
                     if T0 < cfg.max_seq_len else 0)
         if n_cached > 0:
             params = self._params()
@@ -625,7 +628,11 @@ class GPTForCausalLM(Layer):
         once generation outgrows the KV cache (= max_seq_len). Every window
         is full-width here, so the jitted forward compiles once."""
         cfg = self.config
-        fwd = jax.jit(lambda p, t: forward(p, t, cfg)[:, -1])
+        if getattr(self, '_sliding_fwd', None) is None:
+            # cached like _decode_fns: repeated boundary-crossing generate()
+            # calls must not recompile the full-width forward each time
+            self._sliding_fwd = jax.jit(lambda p, t: forward(p, t, cfg)[:, -1])
+        fwd = self._sliding_fwd
         for _ in range(max_new_tokens):
             ctx = toks[:, -cfg.max_seq_len:]
             nxt = _sample(fwd(self._params(), ctx), temperature, top_k)

@@ -100,3 +100,45 @@ def test_getitem_grad():
     expect = np.zeros_like(a)
     expect[1:3] = 1
     assert np.allclose(x.grad.numpy(), expect)
+
+
+def test_pylayer_custom_autograd():
+    """PyLayer user journey (reference: autograd/py_layer.py): custom
+    forward/backward with ctx.save_for_backward / ctx.saved_tensor()."""
+    import paddle_tpu as paddle
+
+    class Cube(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            x, = ctx.saved_tensor()
+            return grad * 3 * x * x
+
+    x = paddle.to_tensor(np.array([2.0], dtype='float32'))
+    x.stop_gradient = False
+    y = Cube.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_pylayer_multiple_outputs():
+    import paddle_tpu as paddle
+
+    class SplitScale(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2, x * 3
+
+        @staticmethod
+        def backward(ctx, g1, g2):
+            return g1 * 2 + g2 * 3
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], dtype='float32'))
+    x.stop_gradient = False
+    a, b = SplitScale.apply(x)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
