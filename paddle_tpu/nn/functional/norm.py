@@ -89,9 +89,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             mean = jnp.mean(v, axis=axes)
             var = jnp.mean(jnp.square(v), axis=axes) - jnp.square(mean)
             if mesh_axis is not None:
-                mean = jax.lax.pmean(mean, mesh_axis)
-                var = jax.lax.pmean(var + jnp.square(mean), mesh_axis)
-                var = var - jnp.square(mean)
+                try:
+                    # global var = pmean(E_local[x^2]) - gmean^2; the
+                    # E[x^2] term must use the LOCAL mean (using the global
+                    # mean here would drop the between-shard variance)
+                    ex2 = jax.lax.pmean(var + jnp.square(mean), mesh_axis)
+                    mean = jax.lax.pmean(mean, mesh_axis)
+                    var = ex2 - jnp.square(mean)
+                except NameError:
+                    # axis not bound: running outside shard_map/pmap (eager
+                    # single-device) — reference SyncBatchNorm degrades to
+                    # plain BatchNorm there
+                    pass
         else:
             mean, var = rm, rv
         shape = [1] * v.ndim
