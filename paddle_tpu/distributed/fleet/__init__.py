@@ -134,7 +134,10 @@ class _DistributedOptimizer:
             lars_weight_decay=cfg.lars_weight_decay or 0.0005,
             epsilon=cfg.epsilon or 1e-9,
             exclude_from_weight_decay=cfg.exclude_from_weight_decay,
-            parameters=opt._parameters, grad_clip=opt._grad_clip)
+            # forward parameter GROUPS when present — rebuilding from the
+            # flat list would silently drop per-group lr/decay overrides
+            parameters=opt._param_groups or opt._parameters,
+            grad_clip=opt._grad_clip)
 
     def make_localsgd_step(self, loss_fn, mesh=None):
         """strategy.localsgd: build the k-local-steps-then-average train

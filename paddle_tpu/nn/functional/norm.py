@@ -97,10 +97,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                     mean = jax.lax.pmean(mean, mesh_axis)
                     var = ex2 - jnp.square(mean)
                 except NameError:
-                    # axis not bound: running outside shard_map/pmap (eager
-                    # single-device) — reference SyncBatchNorm degrades to
-                    # plain BatchNorm there
-                    pass
+                    bound = {}
+                    try:
+                        from jax._src.core import get_axis_env
+                        bound = dict(get_axis_env().axis_sizes)
+                    except Exception:   # pragma: no cover — jax internals
+                        pass
+                    if bound:
+                        # we ARE inside a mapped context but this axis name
+                        # is not bound there — a typo'd mesh_axis must be
+                        # loud, not silently-local statistics
+                        raise
+                    # genuinely outside shard_map/pmap (eager single-device):
+                    # reference SyncBatchNorm degrades to plain BatchNorm
         else:
             mean, var = rm, rv
         shape = [1] * v.ndim

@@ -74,9 +74,17 @@ class AdamW(Adam):
         self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
 
-    def _update(self, g, p, state, lr):
-        p = p * (1 - lr.astype(p.dtype) * self._coeff)
-        return super()._update(g, p, state, lr)
+    # decoupled decay is applied by the base fused step (honoring per-group
+    # weight_decay overrides), not inside _update
+    _decoupled = True
+
+    def _decoupled_coeff(self, wd):
+        if wd is None:
+            return self._coeff
+        from ..regularizer import L2Decay
+        if isinstance(wd, L2Decay):
+            return wd._coeff
+        return float(wd)
 
 
 class Adamax(Optimizer):

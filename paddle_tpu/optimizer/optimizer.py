@@ -19,15 +19,48 @@ from . import lr as lr_mod
 
 
 class Optimizer:
+    _decoupled = False       # AdamW-style weight decay (set by subclasses)
+
+    def _decoupled_coeff(self, wd):   # pragma: no cover — decoupled only
+        raise NotImplementedError
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         self._lr = learning_rate
-        self._parameters = list(parameters) if parameters is not None else []
         self._grad_clip = grad_clip
-        from ..regularizer import L2Decay, L1Decay
+        from ..regularizer import L2Decay
         if isinstance(weight_decay, float):
             weight_decay = L2Decay(weight_decay)
         self._weight_decay = weight_decay
+        # reference parameter groups (optimizer.py docs): ``parameters`` may
+        # be a list of dicts {'params': [...], 'learning_rate'/
+        # 'weight_decay'/'grad_clip': override} — each group steps with its
+        # own hyperparameters
+        params_in = list(parameters) if parameters is not None else []
+        self._param_groups = []
+        if params_in and isinstance(params_in[0], dict):
+            flat = []
+            for g in params_in:
+                gp = list(g['params'])
+                flat += gp
+                entry = {'params': gp}
+                # key-presence semantics: an explicit 0 / 0.0 / None is an
+                # OVERRIDE (e.g. exempting a group from decay), absence
+                # inherits the optimizer-level setting
+                if 'learning_rate' in g:
+                    entry['learning_rate'] = g['learning_rate']
+                if 'weight_decay' in g:
+                    gwd = g['weight_decay']
+                    if isinstance(gwd, (int, float)) and not isinstance(
+                            gwd, bool):
+                        gwd = L2Decay(float(gwd))
+                    entry['weight_decay'] = gwd
+                if 'grad_clip' in g:
+                    entry['grad_clip'] = g['grad_clip']
+                self._param_groups.append(entry)
+            self._parameters = flat
+        else:
+            self._parameters = params_in
         self._states = {}           # id(param) -> state dict of jax arrays
         self._step_fn = None
         self._accumulated = 0
@@ -59,11 +92,12 @@ class Optimizer:
             return self._weight_decay._coeff
         return 0.0
 
-    def _apply_decay(self, g, p):
+    def _apply_decay(self, g, p, wd=None):
         """L2 regularization folded into grad (paddle semantics: regularizer
         adds coeff*p to the gradient; AdamW instead decays weights directly)."""
         from ..regularizer import L1Decay, L2Decay
-        wd = self._weight_decay
+        if wd is None:
+            wd = self._weight_decay
         if isinstance(wd, L2Decay):
             return g + wd._coeff * p
         if isinstance(wd, L1Decay):
@@ -71,28 +105,42 @@ class Optimizer:
         return g
 
     # ---- eager step -----------------------------------------------------
+    def _iter_groups(self):
+        if self._param_groups:
+            for i, g in enumerate(self._param_groups):
+                yield i, g['params'], g
+        else:
+            yield 0, self._parameters, None
+
     def step(self):
-        params = [p for p in self._parameters
-                  if isinstance(p, Parameter) and p.grad is not None and p.trainable]
-        if not params:
-            return
-        for p in params:
-            if id(p) not in self._states:
-                self._states[id(p)] = self.init_state(p._value)
-        grads = [p.grad._value for p in params]
-        vals = [p._value for p in params]
-        states = [self._states[id(p)] for p in params]
-        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        for gi, plist, group in self._iter_groups():
+            params = [p for p in plist
+                      if isinstance(p, Parameter) and p.grad is not None
+                      and p.trainable]
+            if not params:
+                continue
+            for p in params:
+                if id(p) not in self._states:
+                    self._states[id(p)] = self.init_state(p._value)
+            grads = [p.grad._value for p in params]
+            vals = [p._value for p in params]
+            states = [self._states[id(p)] for p in params]
+            def _of(key, default):
+                return group[key] if group and key in group else default
+            lr = jnp.asarray(_of('learning_rate', None)
+                             if _of('learning_rate', None) is not None
+                             else self.get_lr(), jnp.float32)
+            clip = _of('grad_clip', self._grad_clip)
+            wd = _of('weight_decay', self._weight_decay)
 
-        new_vals, new_states = self._fused_apply(tuple(range(len(params))))(
-            grads, vals, states, lr)
-        for p, v, s in zip(params, new_vals, new_states):
-            p._replace_value(v)
-            self._states[id(p)] = s
+            new_vals, new_states = self._fused_apply(
+                gi, clip, wd)(grads, vals, states, lr)
+            for p, v, s in zip(params, new_vals, new_states):
+                p._replace_value(v)
+                self._states[id(p)] = s
 
-    @functools.lru_cache(maxsize=8)
-    def _fused_apply(self, _key):
-        clip = self._grad_clip
+    @functools.lru_cache(maxsize=16)
+    def _fused_apply(self, _key, clip=None, wd=None):
 
         @jax.jit
         def apply(grads, vals, states, lr):
@@ -101,7 +149,13 @@ class Optimizer:
             outs = []
             outstates = []
             for g, p, s in zip(grads, vals, states):
-                g = self._apply_decay(g, p)
+                if self._decoupled:
+                    # AdamW-style decay: applied to the WEIGHTS before the
+                    # update, honoring the per-group coefficient
+                    p = p * (1 - lr.astype(p.dtype)
+                             * self._decoupled_coeff(wd))
+                else:
+                    g = self._apply_decay(g, p, wd)
                 np_, ns = self._update(g, p, s, lr)
                 outs.append(np_)
                 outstates.append(ns)
@@ -184,7 +238,11 @@ class Optimizer:
                 new_p.append(p)
                 new_s.append(s)
                 continue
-            g = self._apply_decay(g.astype(p.dtype), p)
+            g = g.astype(p.dtype)
+            if self._decoupled:
+                p = p * (1 - lr.astype(p.dtype) * self._decoupled_coeff(None))
+            else:
+                g = self._apply_decay(g, p)
             np_, ns_ = self._update(g, p, s, lr)
             new_p.append(np_)
             new_s.append(ns_)

@@ -122,3 +122,83 @@ def test_gradscaler():
     scaler.step(opt)
     # grad = 2*p = 2; step: p - 0.1*2
     assert np.allclose(p.numpy(), 0.8, atol=1e-5)
+
+
+def test_parameter_groups():
+    """Reference feature: parameters as a list of dicts with per-group
+    learning_rate / weight_decay / grad_clip overrides."""
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(0)
+    l1, l2 = nn.Linear(4, 4), nn.Linear(4, 2)
+    w1_before = np.asarray(l1.weight._value).copy()
+    w2_before = np.asarray(l2.weight._value).copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=[
+        {'params': l1.parameters(), 'learning_rate': 0.5},
+        {'params': l2.parameters()},                 # inherits global lr 0.0
+    ])
+    x = paddle.to_tensor(rng.rand(3, 4).astype('float32'))
+    loss = (l2(l1(x)) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # group 1 moved (lr 0.5), group 2 frozen (global lr 0.0)
+    assert not np.allclose(np.asarray(l1.weight._value), w1_before)
+    np.testing.assert_array_equal(np.asarray(l2.weight._value), w2_before)
+
+
+def test_parameter_groups_weight_decay():
+    import paddle_tpu.nn as nn
+    l1, l2 = nn.Linear(4, 4, bias_attr=False), nn.Linear(4, 4, bias_attr=False)
+    l2.weight._replace_value(l1.weight._value)       # identical start
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[
+        {'params': l1.parameters(), 'weight_decay': 0.5},
+        {'params': l2.parameters()},
+    ])
+    # zero gradient: only decay moves weights
+    for l in (l1, l2):
+        loss = (l(paddle.to_tensor(np.zeros((2, 4), 'float32')))).sum()
+        loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # decayed group shrank toward 0; undecayed group unchanged by decay
+    n1 = np.abs(np.asarray(l1.weight._value)).sum()
+    n2 = np.abs(np.asarray(l2.weight._value)).sum()
+    assert n1 < n2
+
+
+def test_adamw_group_decay_exemption():
+    """The common param-group use case: exempting norm/bias params from
+    AdamW's decoupled decay via 'weight_decay': 0.0 — and the override is
+    honored as DECOUPLED decay, not an Adam-style L2 grad fold."""
+    import paddle_tpu.nn as nn
+    l1, l2 = nn.Linear(4, 4, bias_attr=False), nn.Linear(4, 4, bias_attr=False)
+    l2.weight._replace_value(l1.weight._value)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[
+                                     {'params': l1.parameters()},
+                                     {'params': l2.parameters(),
+                                      'weight_decay': 0.0}])
+    # zero grads: only decoupled decay moves weights
+    for l in (l1, l2):
+        (l(paddle.to_tensor(np.zeros((2, 4), 'float32'))) * 0).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    w1 = np.asarray(l1.weight._value)
+    w2 = np.asarray(l2.weight._value)
+    # exempt group untouched by decay; decayed group = w * (1 - lr*coeff)
+    np.testing.assert_allclose(w2, np.asarray(l2.weight._value))
+    np.testing.assert_allclose(w1, w2 * (1 - 0.1 * 0.5), rtol=1e-6)
+
+
+def test_int_zero_group_decay_is_an_override():
+    import paddle_tpu.nn as nn
+    l = nn.Linear(4, 4, bias_attr=False)
+    before = np.asarray(l.weight._value).copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, weight_decay=0.01,
+                               parameters=[{'params': l.parameters(),
+                                            'weight_decay': 0}])
+    (l(paddle.to_tensor(np.zeros((2, 4), 'float32'))) * 0).sum().backward()
+    opt.step()
+    # zero grad + exempted decay: nothing moves (int 0 must not silently
+    # fall back to the global 0.01)
+    np.testing.assert_array_equal(np.asarray(l.weight._value), before)
