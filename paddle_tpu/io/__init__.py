@@ -231,7 +231,12 @@ def default_collate_fn(batch):
         return Tensor(np.stack([np.asarray(s._value) for s in batch]))
     if isinstance(sample, np.ndarray):
         return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    import numbers
+    if (isinstance(sample, numbers.Number)
+            or isinstance(sample, (np.number, np.bool_))):
+        # covers python ints/floats AND NUMERIC numpy scalars (np.float32(i)
+        # is not a python float; np.str_/np.bytes_ must still fall through
+        # untouched — a unicode array is not a Tensor)
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
         return tuple(default_collate_fn([b[i] for b in batch])

@@ -209,6 +209,10 @@ def save(layer, path, input_spec=None, **configs):
     """
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
     from ..framework_io import save as fsave
+    if not isinstance(layer, Layer):
+        # reference jit.save also accepts a @to_static FUNCTION: persist it
+        # as a param-less program (StaticFunction or plain callable)
+        return _save_function(layer, path, input_spec)
     fwd = layer.forward
     state = {'params': {n: np.asarray(p._value) for n, p in layer.named_parameters()},
              'buffers': {n: np.asarray(b._value) for n, b in layer.named_buffers()}}
@@ -236,75 +240,118 @@ def save(layer, path, input_spec=None, **configs):
             out, _ = functional_call(layer, params, buffers, *xs)
             return out
         try:
-            lowered = jax.jit(infer_fn).lower(*examples)
-            with open(path + '.stablehlo', 'w') as f:
-                f.write(lowered.as_text())
-            # Standalone serialized program (jax.export): the portable
-            # analogue of the reference's __model__ ProgramDesc — the
-            # Predictor deserializes and runs it WITHOUT the Python Layer.
-            # Dims marked -1/None in the InputSpec become symbolic so one
-            # artifact serves any size along those axes. Tried in order:
-            # one symbol per dynamic dim (fully independent), one shared
-            # symbol (programs that require equal dynamic dims, e.g. two
-            # inputs added together), then fully concrete example shapes.
-            meta['exported'] = False
-            meta['poly_batch'] = False
-            from jax import export as jax_export
-            p_struct = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), pd)
-            b_struct = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bd)
-
-            def _sym_specs(shared):
-                n_dyn = sum(1 for s in specs for d in s.shape
-                            if d is None or d == -1)
-                if n_dyn == 0:
-                    return None, False
-                names = 'b' if shared else ', '.join(
-                    f'b{i}' for i in range(n_dyn))
-                syms = list(jax_export.symbolic_shape(names))
-                it = iter(syms * n_dyn if shared else syms)
-                out = []
-                for s in specs:
-                    dims = [next(it) if (d is None or d == -1) else int(d)
-                            for d in s.shape]
-                    out.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
-                return out, True
-
-            n_dyn_total = sum(1 for s in specs for d in s.shape
-                              if d is None or d == -1)
-            attempts = []
-            for shared in ((False, True) if n_dyn_total > 1 else (False,)):
-                ss, poly = _sym_specs(shared)
-                if ss is not None:
-                    attempts.append((ss, poly))
-                if not poly:
-                    break
-            attempts.append(([jax.ShapeDtypeStruct(e.shape, e.dtype)
-                              for e in examples], False))
-            for in_specs, poly in attempts:
-                try:
-                    exported = jax_export.export(jax.jit(infer_fn_functional))(
-                        p_struct, b_struct, *in_specs)
-                    blob = exported.serialize()
-                except Exception as e:   # noqa: BLE001 — try next shape mode
-                    # keep the cause: a silent exported=False cost a round-3
-                    # debugging session (to_static leaf-count corruption)
-                    meta['export_error'] = (f'{e.__class__.__name__}: '
-                                            f'{e}'[:300])
-                    continue
-                with open(path + '.pdexec', 'wb') as f:
-                    f.write(blob)
-                meta['exported'] = True
-                meta['poly_batch'] = poly
-                meta.pop('export_error', None)
-                break
-            if not meta['exported'] and os.path.exists(path + '.pdexec'):
-                os.unlink(path + '.pdexec')   # drop stale program from prior save
+            _export_artifacts(infer_fn, infer_fn_functional, pd, bd, specs,
+                              examples, path, meta)
         finally:
             if was_training:
                 layer.train()
     import json
+    with open(path + '.pdmodel', 'w') as f:
+        json.dump(meta, f)
+
+
+def _export_artifacts(infer_fn, infer_fn_functional, pd, bd, specs, examples,
+                      path, meta):
+    """Shared export machinery for Layer and function saves: StableHLO dump
+    plus the standalone serialized program (jax.export) — the portable
+    analogue of the reference's __model__ ProgramDesc, which the Predictor
+    runs WITHOUT the Python object. Dims marked -1/None become symbolic so
+    one artifact serves any size along those axes. Tried in order: one
+    symbol per dynamic dim (fully independent), one shared symbol (programs
+    that require equal dynamic dims, e.g. two inputs added together), then
+    fully concrete example shapes. On total failure the cause lands in
+    meta['export_error'] and any stale .pdexec from a prior save is removed.
+    """
+    lowered = jax.jit(infer_fn).lower(*examples)
+    with open(path + '.stablehlo', 'w') as f:
+        f.write(lowered.as_text())
+    meta['exported'] = False
+    meta['poly_batch'] = False
+    from jax import export as jax_export
+    p_struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), pd)
+    b_struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bd)
+
+    def _sym_specs(shared):
+        n_dyn = sum(1 for s in specs for d in s.shape
+                    if d is None or d == -1)
+        if n_dyn == 0:
+            return None, False
+        names = 'b' if shared else ', '.join(f'b{i}' for i in range(n_dyn))
+        syms = list(jax_export.symbolic_shape(names))
+        it = iter(syms * n_dyn if shared else syms)
+        out = []
+        for s in specs:
+            dims = [next(it) if (d is None or d == -1) else int(d)
+                    for d in s.shape]
+            out.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+        return out, True
+
+    n_dyn_total = sum(1 for s in specs for d in s.shape
+                      if d is None or d == -1)
+    attempts = []
+    for shared in ((False, True) if n_dyn_total > 1 else (False,)):
+        ss, poly = _sym_specs(shared)
+        if ss is not None:
+            attempts.append((ss, poly))
+        if not poly:
+            break
+    attempts.append(([jax.ShapeDtypeStruct(e.shape, e.dtype)
+                      for e in examples], False))
+    for in_specs, poly in attempts:
+        try:
+            exported = jax_export.export(jax.jit(infer_fn_functional))(
+                p_struct, b_struct, *in_specs)
+            blob = exported.serialize()
+        except Exception as e:   # noqa: BLE001 — try next shape mode
+            # keep the cause: a silent exported=False cost a round-3
+            # debugging session (to_static leaf-count corruption)
+            meta['export_error'] = f'{e.__class__.__name__}: {e}'[:300]
+            continue
+        with open(path + '.pdexec', 'wb') as f:
+            f.write(blob)
+        meta['exported'] = True
+        meta['poly_batch'] = poly
+        meta.pop('export_error', None)
+        break
+    if not meta['exported'] and os.path.exists(path + '.pdexec'):
+        os.unlink(path + '.pdexec')   # drop stale program from a prior save
+
+
+def _save_function(fn, path, input_spec):
+    """jit.save for a function: .pdparams carries empty state; the .pdexec
+    program takes only the inputs."""
+    import json
+    from ..framework_io import save as fsave
+    raw = fn._fn if isinstance(fn, StaticFunction) else fn
+    spec = input_spec or getattr(fn, '_input_spec', None)
+    if spec is None:
+        raise ValueError('jit.save of a function requires input_spec')
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in spec]
+    fsave({'params': {}, 'buffers': {}}, path + '.pdparams')
+    meta = {'class': getattr(raw, '__name__', 'function'), 'function': True,
+            'input_spec': [{'shape': [(-1 if d is None else int(d))
+                                      for d in s.shape],
+                            'dtype': str(np.dtype(s.dtype).name)}
+                           for s in specs]}
+
+    def infer_fn_functional(params, buffers, *xs):
+        from ..core.tensor import no_grad_ctx
+        targs = [Tensor(x) for x in xs]
+        with no_grad_ctx():
+            res = raw(*targs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, res,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    def infer_fn(*xs):
+        return infer_fn_functional({}, {}, *xs)
+
+    examples = [_spec_to_example(s) for s in specs]
+    _export_artifacts(infer_fn, infer_fn_functional, {}, {}, specs, examples,
+                      path, meta)
     with open(path + '.pdmodel', 'w') as f:
         json.dump(meta, f)
 

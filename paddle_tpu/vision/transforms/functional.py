@@ -137,7 +137,9 @@ def adjust_hue(img, hue_factor):
     p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
     i = i % 6
     out = np.select(
-        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        # conditions lifted to [..., 1] so they broadcast against the
+        # [..., 3] RGB choices
+        [(i == k)[..., None] for k in range(6)],
         [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
          np.stack([p, v, t], -1), np.stack([p, q, v], -1),
          np.stack([t, p, v], -1), np.stack([v, p, q], -1)])

@@ -299,3 +299,19 @@ def test_voc2012_concurrent_reads(tmp_path):
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+def test_color_jitter_hue_3ch():
+    """Regression: adjust_hue's np.select conditions must broadcast against
+    the RGB choices (was (H,W) vs (H,W,3))."""
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(16, 16, 3) * 255).astype('uint8')
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert out.shape == (16, 16, 3)
+    # pure hue path deterministically
+    from paddle_tpu.vision.transforms import functional as TF
+    out2 = TF.adjust_hue(img, 0.25)
+    assert out2.shape == (16, 16, 3)
+    # hue rotation preserves value channel (max of RGB)
+    np.testing.assert_allclose(out2.astype('float32').max(-1),
+                               img.astype('float32').max(-1), atol=2.0)

@@ -155,3 +155,21 @@ def test_hapi_grad_accum_flushes_across_epochs():
               accumulate_grad_batches=4, shuffle=False)
     assert getattr(model, '_grad_acc', None) is None
     assert getattr(model, '_accum_count', 0) == 0
+
+
+def test_collate_numpy_scalars_stack():
+    """numpy scalar samples must collate into a stacked Tensor (reference
+    default_collate uses numbers.Number; np.float32 is not a python float)."""
+    class IDS(paddle.io.IterableDataset):
+        def __iter__(self):
+            for i in range(20):
+                yield np.float32(i)
+
+    for workers in (0, 2):
+        loader = paddle.io.DataLoader(IDS(), batch_size=4,
+                                      num_workers=workers)
+        total = 0.0
+        for b in loader:
+            assert not isinstance(b, list), type(b)
+            total += float(b.numpy().sum())
+        assert total == float(sum(range(20)))

@@ -222,3 +222,23 @@ def test_program_translator_enable_false_runs_dygraph():
     f(x)
     # compiled: traced once (cache hit on the second call)
     assert len(calls) == n0 + 1
+
+
+def test_jit_save_function(tmp_path):
+    """jit.save of a @to_static FUNCTION (reference supports functions, not
+    only Layers): save -> load -> Predictor, symbolic batch."""
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    path = os.path.join(str(tmp_path), 'fn')
+    paddle.jit.save(f, path,
+                    input_spec=[paddle.static.InputSpec([None, 4], 'float32')])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(np.asarray(loaded(np.ones((2, 4), 'float32'))),
+                               np.full((2, 4), 3.0))
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(path + '.pdmodel'))
+    for b in (1, 5):
+        out = np.asarray(pred.run([np.ones((b, 4), 'float32')])[0])
+        np.testing.assert_allclose(out, np.full((b, 4), 3.0))
