@@ -36,9 +36,19 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
     return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
 
 
-@op
 def t(input, name=None):
-    return input.T if input.ndim <= 2 else input
+    """Matrix transpose (reference tensor/linalg.py t): 0/1-D returns a
+    copy, 2-D transposes, >2-D raises — the reference errors there too."""
+    if input.ndim > 2:
+        raise ValueError(
+            'paddle.t only supports tensors with <= 2 dimensions; got '
+            f'{input.ndim}-D (use paddle.transpose)')
+    return _t_op(input)
+
+
+@op
+def _t_op(input):
+    return input.T
 
 
 def unstack(x, axis=0, num=None):

@@ -38,6 +38,13 @@ def install():
     # paddle method-only names
     Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
     Tensor.cast = Tensor.astype
+
+    def _t(self, name=None):
+        # one shared implementation with paddle.t (always a NEW tensor —
+        # aliasing self would let in-place ops on the result corrupt it)
+        from ..compat_api import t as _t_fn
+        return _t_fn(self)
+    Tensor.t = _t
     Tensor.dim = lambda self: self.ndim
     Tensor.numel = lambda self: stat.numel(self)
     Tensor.einsum = None  # not a method

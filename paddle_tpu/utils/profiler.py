@@ -1,0 +1,51 @@
+"""paddle.utils.profiler — the 2.1 profiler module surface.
+
+Reference: python/paddle/utils/profiler.py (start_profiler/stop_profiler/
+reset_profiler free functions + the deprecated Profiler shim). TPU-native:
+delegates to paddle_tpu.profiler's jax.profiler wrapper; traces land as
+TensorBoard-compatible protobufs.
+"""
+import contextlib
+
+from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+_active = None
+
+
+def start_profiler(state='All', tracer_option='Default', log_dir='./profiler_log'):
+    """Begin a global profiling session (reference free-function API)."""
+    global _active
+    if _active is None:
+        _active = Profiler(log_dir=log_dir)
+        _active.start()
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    global _active
+    if _active is not None:
+        _active.stop()
+        _active = None
+
+
+def reset_profiler():
+    global _active
+    if _active is not None:
+        _active._step_times = []
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option='Default'):
+    """``with paddle.utils.profiler.profiler(...):`` context (reference
+    fluid.profiler.profiler)."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def cuda_profiler(*a, **kw):  # pragma: no cover — CUDA-only in the reference
+    raise RuntimeError('cuda_profiler is CUDA-specific; use '
+                       'paddle.utils.profiler.profiler() (jax.profiler '
+                       'traces) on TPU')
