@@ -139,7 +139,9 @@ class Dirac(Initializer):
 
 
 def set_global_initializer(weight_init, bias_init=None):
-    import paddle_tpu.nn.layer_base as lb
-    # Stored for create_parameter defaults (coarse parity).
+    """Reference nn/initializer/set_global_initializer: applies to every
+    subsequently-created parameter unless a ParamAttr names its own
+    initializer; set_global_initializer(None) restores the defaults.
+    Consumed by Layer.create_parameter."""
     set_global_initializer.weight = weight_init
     set_global_initializer.bias = bias_init

@@ -117,7 +117,13 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer or \
+        # priority (reference nn/initializer/set_global_initializer): an
+        # explicit ParamAttr initializer wins, then the global initializer
+        # (weight_init for weights, bias_init for biases), then the layer's
+        # default, then the framework fallback
+        ginit = getattr(I.set_global_initializer,
+                        'bias' if is_bias else 'weight', None)
+        init = attr.initializer or ginit or default_initializer or \
             (I.Constant(0.0) if is_bias else I.XavierNormal())
         value = init(shape, dtypes.convert_dtype(dtype))
         p = Parameter(value, trainable=attr.trainable, name=attr.name)

@@ -29,6 +29,7 @@ class OpLastCheckpointChecker:
 from .deprecated import deprecated  # noqa: F401
 from .install_check import run_check  # noqa: F401
 from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+from . import profiler  # noqa: F401  (paddle.utils.profiler module surface)
 
 __all__ = ['deprecated', 'run_check', 'require_version', 'try_import']
 

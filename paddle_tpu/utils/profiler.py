@@ -1,48 +1,31 @@
 """paddle.utils.profiler — the 2.1 profiler module surface.
 
-Reference: python/paddle/utils/profiler.py (start_profiler/stop_profiler/
-reset_profiler free functions + the deprecated Profiler shim). TPU-native:
-delegates to paddle_tpu.profiler's jax.profiler wrapper; traces land as
-TensorBoard-compatible protobufs.
+Reference: python/paddle/utils/profiler.py, which re-exports the SAME
+functions as fluid.profiler. This module therefore only delegates to the
+canonical implementations in paddle_tpu.profiler — no second copy of the
+session state, so the utils and top-level entry points compose.
 """
 import contextlib
 
-from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
-
-_active = None
-
-
-def start_profiler(state='All', tracer_option='Default', log_dir='./profiler_log'):
-    """Begin a global profiling session (reference free-function API)."""
-    global _active
-    if _active is None:
-        _active = Profiler(log_dir=log_dir)
-        _active.start()
-
-
-def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
-    global _active
-    if _active is not None:
-        _active.stop()
-        _active = None
+from ..profiler import (  # noqa: F401
+    Profiler, ProfilerOptions, get_profiler, start_profiler, stop_profiler)
 
 
 def reset_profiler():
-    global _active
-    if _active is not None:
-        _active._step_times = []
+    """No persistent aggregate state in the jax.profiler wrapper; kept for
+    API parity (reference resets the op-stat accumulators)."""
 
 
 @contextlib.contextmanager
 def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
              tracer_option='Default'):
-    """``with paddle.utils.profiler.profiler(...):`` context (reference
-    fluid.profiler.profiler)."""
-    start_profiler(state, tracer_option)
-    try:
-        yield
-    finally:
-        stop_profiler(sorted_key, profile_path)
+    """``with paddle.utils.profiler.profiler(...):`` — delegates to the
+    canonical context in paddle_tpu.profiler (owns exactly the session it
+    starts)."""
+    from .. import profiler as _p
+    with _p.profiler(state=state, sorted_key=sorted_key,
+                     profile_path=profile_path) as p:
+        yield p
 
 
 def cuda_profiler(*a, **kw):  # pragma: no cover — CUDA-only in the reference

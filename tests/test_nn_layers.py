@@ -223,3 +223,22 @@ def test_syncbn_cross_replica_stats_exact():
     gv = x.var(0)
     want = (x - gm) / np.sqrt(gv + 1e-5)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_set_global_initializer():
+    """Reference semantics: the global initializer governs every new
+    parameter until reset; an explicit ParamAttr initializer still wins."""
+    import paddle_tpu as paddle
+    I = nn.initializer
+    I.set_global_initializer(I.Constant(0.5), I.Constant(-0.5))
+    try:
+        l = nn.Linear(3, 3)
+        assert np.allclose(l.weight.numpy(), 0.5)
+        assert np.allclose(l.bias.numpy(), -0.5)
+        l2 = nn.Linear(3, 3, weight_attr=paddle.ParamAttr(
+            initializer=I.Constant(2.0)))
+        assert np.allclose(l2.weight.numpy(), 2.0)   # explicit attr wins
+    finally:
+        I.set_global_initializer(None)
+    l3 = nn.Linear(3, 3)
+    assert not np.allclose(l3.weight.numpy(), 0.5)   # defaults restored
