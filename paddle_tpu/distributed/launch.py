@@ -43,6 +43,14 @@ def _parse(argv=None):
                    default=int(os.environ.get('PADDLE_TRAINERS_NUM', '1')))
     p.add_argument('--node_rank', type=int,
                    default=int(os.environ.get('PADDLE_TRAINER_ID', '0')))
+    # reference CLI compat: --nproc_per_node spawns that many local
+    # jax.distributed processes (on TPU the normal layout is ONE process
+    # per host driving all local chips). --gpus/--devices take the
+    # reference's comma-separated device-id list; here the LIST LENGTH is
+    # the local process count (the ids themselves are meaningless for a
+    # TPU mesh).
+    p.add_argument('--nproc_per_node', dest='nproc', type=int, default=None)
+    p.add_argument('--gpus', '--devices', dest='device_list', default=None)
     p.add_argument('--master', default=os.environ.get('PADDLE_MASTER', ''))
     p.add_argument('--max_restarts', type=int, default=0)
     p.add_argument('--heartbeat_timeout', type=float, default=0.0,
@@ -63,65 +71,109 @@ def _kill(proc):
         proc.wait()
 
 
-def _run_once(cmd, env, hb_path, hb_timeout):
-    """One child lifetime. Returns (exit_code | None, hung: bool)."""
-    if hb_path:
-        env = dict(env, **{HEARTBEAT_ENV: hb_path})
-        with open(hb_path, 'a'):
-            os.utime(hb_path, None)       # fresh epoch for this lifetime
-    proc = subprocess.Popen(cmd, env=env)
+_shutdown_requested = False
+
+
+def _run_group(cmd, envs, hb_paths, hb_timeout):
+    """One lifetime of the local process group. All-or-nothing (elastic
+    restarts are whole-group, like the reference): first nonzero exit or
+    stale heartbeat kills the rest. Returns (exit_code | None, hung)."""
+    procs = []
+    for env, hb in zip(envs, hb_paths):
+        if hb:
+            env = dict(env, **{HEARTBEAT_ENV: hb})
+            with open(hb, 'a'):
+                os.utime(hb, None)        # fresh epoch for this lifetime
+        procs.append(subprocess.Popen(cmd, env=env))
 
     def _fwd(sig, frame):
-        proc.send_signal(sig)
+        # record the external shutdown so main() EXITS instead of treating
+        # the children's 143s as a crash and resurrecting the job
+        global _shutdown_requested
+        _shutdown_requested = True
+        for p in procs:
+            p.send_signal(sig)
     signal.signal(signal.SIGTERM, _fwd)
 
-    if not (hb_path and hb_timeout > 0):
-        return proc.wait(), False
-    while True:
-        try:
-            return proc.wait(timeout=min(hb_timeout / 4.0, 5.0)), False
-        except subprocess.TimeoutExpired:
-            pass
-        try:
-            stale = time.time() - os.path.getmtime(hb_path)
-        except OSError:
-            stale = 0.0
-        if stale > hb_timeout:
-            print(f'[launch] heartbeat stale {stale:.0f}s '
-                  f'(> {hb_timeout:.0f}s): child presumed hung, killing',
-                  file=sys.stderr)
-            _kill(proc)
-            return None, True
+    live = set(range(len(procs)))
+    poll_s = min(hb_timeout / 4.0, 5.0) if hb_timeout > 0 else 1.0
+    while live:
+        time.sleep(poll_s if len(live) < len(procs) or hb_timeout > 0
+                   else 0.2)
+        for i in sorted(live):
+            code = procs[i].poll()
+            if code is not None:
+                live.discard(i)
+                if code != 0:
+                    for j in live:
+                        _kill(procs[j])
+                    return code, False
+        if hb_timeout > 0:
+            for i in sorted(live):
+                hb = hb_paths[i]
+                try:
+                    stale = time.time() - os.path.getmtime(hb)
+                except OSError:
+                    stale = 0.0
+                if stale > hb_timeout:
+                    print(f'[launch] rank {i} heartbeat stale {stale:.0f}s '
+                          f'(> {hb_timeout:.0f}s): group presumed hung, '
+                          'killing', file=sys.stderr)
+                    for j in live:
+                        _kill(procs[j])
+                    return None, True
+    return 0, False
 
 
 def main(argv=None):
     args = _parse(argv)
-    env = dict(os.environ)
-    env['PADDLE_TRAINERS_NUM'] = str(args.nnodes)
-    env['PADDLE_TRAINER_ID'] = str(args.node_rank)
-    if args.master:
-        host, _, port = args.master.partition(':')
-        env['PADDLE_MASTER'] = host
-        env['MASTER_PORT'] = port or '8476'
-    hb_path = None
+    if args.nproc is not None:
+        nproc = max(1, args.nproc)
+    elif args.device_list:
+        nproc = len([d for d in args.device_list.split(',') if d != ''])
+    else:
+        nproc = 1
+    total = args.nnodes * nproc
+    master = args.master
+    if not master and args.nnodes == 1 and nproc > 1:
+        # single-node multi-process: localhost coordinator is correct.
+        # Multi-NODE without --master stays unset so init_parallel_env
+        # skips jax.distributed (a loud fast misconfig, not a silent hang
+        # against the wrong host's localhost).
+        master = '127.0.0.1'
+    envs = []
+    for local_rank in range(nproc):
+        env = dict(os.environ)
+        env['PADDLE_TRAINERS_NUM'] = str(total)
+        env['PADDLE_TRAINER_ID'] = str(args.node_rank * nproc + local_rank)
+        env['PADDLE_LOCAL_RANK'] = str(local_rank)
+        if master:
+            host, _, port = master.partition(':')
+            env['PADDLE_MASTER'] = host
+            env['MASTER_PORT'] = port or '8476'
+        envs.append(env)
+    hb_paths = [None] * nproc
     if args.heartbeat_timeout > 0:
         base = args.log_dir or '/tmp'
         os.makedirs(base, exist_ok=True)
-        hb_path = os.path.join(base, f'paddle_hb_{os.getpid()}')
+        hb_paths = [os.path.join(base, f'paddle_hb_{os.getpid()}_{r}')
+                    for r in range(nproc)]
 
     restarts = 0
     while True:
         cmd = ([sys.executable, args.training_script]
                + args.training_script_args)
         start = time.time()
-        code, hung = _run_once(cmd, env, hb_path, args.heartbeat_timeout)
+        code, hung = _run_group(cmd, envs, hb_paths, args.heartbeat_timeout)
         if code == 0:
             return 0
+        if _shutdown_requested:
+            sys.exit(code if code is not None else 1)
         if restarts >= args.max_restarts:
             sys.exit(code if code is not None else 1)
         restarts += 1
         why = 'hung (heartbeat stale)' if hung else f'exited {code}'
-        print(f'[launch] child {why} after {time.time()-start:.0f}s; '
+        print(f'[launch] group {why} after {time.time()-start:.0f}s; '
               f'restart {restarts}/{args.max_restarts}', file=sys.stderr)
 
 

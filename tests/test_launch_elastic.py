@@ -104,7 +104,7 @@ def test_launcher_detects_hang(tmp_path):
     t0 = time.time()
     r = subprocess.run(
         [sys.executable, '-m', 'paddle_tpu.distributed.launch',
-         '--max_restarts', '1', '--heartbeat_timeout', '3',
+         '--max_restarts', '1', '--heartbeat_timeout', '10',
          '--log_dir', str(tmp_path), str(worker)],
         env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-500:]
@@ -139,3 +139,30 @@ def test_dataloader_many_worker_stress():
                 assert y == x * x % 1000, (x, y)   # pairing intact
             seen.extend(xs)
         assert sorted(seen) == list(range(N))      # exactly once
+
+
+def test_launch_cli_nproc_per_node(tmp_path):
+    """The reference CLI form — python -m paddle.distributed.launch
+    --nproc_per_node 2 script.py — spawns a working local
+    jax.distributed group with ranks wired through the env contract."""
+    child = tmp_path / 'child.py'
+    child.write_text(textwrap.dedent("""
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        import paddle_tpu as paddle
+        paddle.distributed.init_parallel_env()
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        r, n = jax.process_index(), jax.process_count()
+        s = multihost_utils.process_allgather(jnp.asarray([float(r)]))
+        assert n == 2 and sorted(s.ravel().tolist()) == [0.0, 1.0], (n, s)
+        print(f'rank {r} OK', flush=True)
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    p = subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+         '--nproc_per_node', '2', str(child)],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert p.stdout.count('OK') == 2, p.stdout
