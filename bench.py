@@ -122,7 +122,8 @@ def _child_train(cfg):
         os.environ['PADDLE_TPU_FLASH_JNP_BWD'] = '1'
     gcfg = gpt.GPTConfig(vocab_size=cfg['vocab'], hidden_size=cfg['hidden'],
                          num_layers=cfg['layers'], num_heads=cfg['heads'],
-                         max_seq_len=seq, dtype='bfloat16', remat=True,
+                         max_seq_len=seq, dtype='bfloat16',
+                         remat=cfg.get('remat', True),
                          use_flash=cfg.get('use_flash', True))
     params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
@@ -350,6 +351,11 @@ def main():
     # (pure XLA attention) -> small model. A kernel regression on the real
     # chip can cost perf but never the round's measurement.
     configs = [
+        # remat off first: at 350M the activations fit HBM comfortably and
+        # skipping the backward recompute is strictly faster; an OOM only
+        # costs this one bounded subprocess before the remat variants
+        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
+             vocab=32768, iters=20, remat=False),
         dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
              vocab=32768, iters=20),
         dict(batch=4, seq=1024, hidden=1024, layers=24, heads=16,
