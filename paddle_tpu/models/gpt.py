@@ -260,18 +260,16 @@ def init_kv_cache(config: GPTConfig, batch):
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
-def _cached_block(bp, x, k_cache, v_cache, pos, config):
-    """One block over a [B, T, H] slice starting at ``pos``; returns the
-    block output and the k/v caches with rows [pos, pos+T) filled.
-    Attention: q rows attend to cache positions <= their absolute index."""
-    cdt = jnp.dtype(config.dtype)
+def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
+    """Shared KV-cache attention core (used by gpt AND moe_gpt decode):
+    writes rows [pos, pos+T) into the caches, attends each q row to cache
+    positions <= its absolute index, applies the output projection +
+    residual. Returns (x_new, k_cache, v_cache)."""
     B, T, h = x.shape
-    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
-    q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     S = k_cache.shape[1]
-    scale = 1.0 / math.sqrt(config.head_dim)
+    scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache) * scale      # [B,H,T,S]
     q_pos = pos + jnp.arange(T)[:, None]                        # [T,1]
     k_pos = jnp.arange(S)[None, :]                              # [1,S]
@@ -279,7 +277,17 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config):
                   jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1).astype(cdt)
     a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache).reshape(B, T, h)
-    x = x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt)
+    return (x + a @ proj_w.astype(cdt) + proj_b.astype(cdt),
+            k_cache, v_cache)
+
+
+def _cached_block(bp, x, k_cache, v_cache, pos, config):
+    """One block over a [B, T, H] slice starting at ``pos``."""
+    cdt = jnp.dtype(config.dtype)
+    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt)
+    x, k_cache, v_cache = cached_attention(
+        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     x = x + _block_mlp(bp, y, cdt) + bp['out_b'].astype(cdt)
     return x, k_cache, v_cache
@@ -315,17 +323,20 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     return logits, {'k': k_new, 'v': v_new}
 
 
-def _sample(logits, temperature, top_k):
+def _sample(logits, temperature, top_k, key=None):
     """Greedy / temperature / top-k next-token draw — the ONE sampling rule
-    shared by the cache path and the sliding-window continuation."""
+    shared by the cache path and the sliding-window continuation. ``key``
+    overrides the global PRNG stream (reproducible functional sampling)."""
     if temperature == 0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    from ..tensor.random import next_key
+    if key is None:
+        from ..tensor.random import next_key
+        key = next_key()
     lg = logits.astype(jnp.float32) / temperature
     if top_k:
         kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
         lg = jnp.where(lg < kth, -jnp.inf, lg)
-    return jax.random.categorical(next_key(), lg, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
 def make_decode_fns(config: GPTConfig):

@@ -7,6 +7,7 @@ bucketed dispatch einsums (paddle_tpu.parallel.moe) lower to all-to-all on
 ICI automatically from the shardings.
 """
 import dataclasses
+import math
 from functools import partial
 
 import jax
@@ -14,7 +15,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.moe import moe_ffn
-from .gpt import _layer_norm, _attention
+from .gpt import _layer_norm, _attention, cached_attention
 
 
 @dataclasses.dataclass
@@ -120,6 +121,121 @@ def loss_fn(params, tokens, targets, config):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + config.aux_weight * aux / config.num_layers
+
+
+# ---------------------------------------------------------------------------
+# KV-cache autoregressive decoding (same design as gpt.py: static
+# [L, B, S_max, H, Dh] cache, one compiled prefill + one compiled step;
+# the MoE FFN routes per TOKEN. NOTE on parity: a 1-wide decode step gives
+# every token full expert capacity, while a long training/prefill sequence
+# COMPETES for capacity_factor-bounded slots — decode equals the full
+# forward exactly whenever no token is dropped (generous capacity), and is
+# otherwise slightly BETTER-routed than training saw)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: 'MoEConfig', batch):
+    cdt = jnp.dtype(config.dtype)
+    shape = (config.num_layers, batch, config.max_seq_len,
+             config.num_heads, config.head_dim)
+    return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
+
+
+def _cached_block(bp, x, k_cache, v_cache, pos, config):
+    cdt = jnp.dtype(config.dtype)
+    B, T, h = x.shape
+    nh, hd = config.num_heads, config.head_dim
+    y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
+    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    q, k, v = (t.reshape(B, T, nh, hd) for t in jnp.split(qkv, 3, axis=-1))
+    x, k_cache, v_cache = cached_attention(
+        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
+    y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
+    ff, _ = moe_ffn(y, bp['gate_w'].astype(cdt), bp['w_in'].astype(cdt),
+                    bp['w_out'].astype(cdt),
+                    capacity_factor=config.capacity_factor)
+    return x + ff, k_cache, v_cache
+
+
+def forward_with_cache(params, tokens, cache, pos, config, last_only=False):
+    """[B, T] tokens at absolute positions starting at ``pos`` (traced
+    scalar) -> (logits, cache). See gpt.forward_with_cache."""
+    cdt = jnp.dtype(config.dtype)
+    B, T = tokens.shape
+    ppos = pos + jnp.arange(T)
+    x = (jnp.take(params['wte'], tokens, axis=0)
+         + jnp.take(params['wpe'], ppos, axis=0)).astype(cdt)
+
+    def scan_body(carry, inp):
+        xx = carry
+        bp, kc, vc = inp
+        xx, kc, vc = _cached_block(bp, xx, kc, vc, pos, config)
+        return xx, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_body, x, (params['blocks'], cache['k'], cache['v']))
+    if last_only:
+        x = x[:, -1:]
+    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+    return x @ params['wte'].T.astype(cdt), {'k': k_new, 'v': v_new}
+
+
+def make_decode_fns(config):
+    """-> (prefill, step) jitted with donated caches (see gpt.py)."""
+    @partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, prompt, cache):
+        logits, cache = forward_with_cache(params, prompt, cache,
+                                           jnp.int32(0), config,
+                                           last_only=True)
+        return logits[:, -1], cache
+
+    @partial(jax.jit, donate_argnums=(3,))
+    def step(params, tok, pos, cache):
+        logits, cache = forward_with_cache(params, tok[:, None], cache, pos,
+                                           config)
+        return logits[:, 0], cache
+
+    return prefill, step
+
+
+_decode_fns_cache = {}
+
+
+def _decode_fns_for(config):
+    """Memoize per config: repeated generate() calls must not rebuild the
+    jit closures (and so recompile prefill/step) every time."""
+    cfg_key = tuple(sorted(dataclasses.asdict(config).items()))
+    if cfg_key not in _decode_fns_cache:
+        _decode_fns_cache[cfg_key] = make_decode_fns(config)
+    return _decode_fns_cache[cfg_key]
+
+
+def generate(params, config, prompt, max_new_tokens, temperature=0.0,
+             top_k=None, key=None):
+    """Functional greedy/sampled generation over the KV cache. ``prompt``:
+    [B, T0] int32 with T0 < max_seq_len; generation is capped at the cache
+    window (T0 + n <= max_seq_len + 1). ``key`` makes sampling
+    reproducible (split per step); otherwise the global stream is used."""
+    from .gpt import _sample
+    B, T0 = prompt.shape
+    if T0 >= config.max_seq_len:
+        raise ValueError(
+            f'prompt length {T0} >= max_seq_len {config.max_seq_len}: the '
+            'KV cache cannot hold it — truncate the prompt or raise '
+            'max_seq_len')
+    n = min(max_new_tokens, config.max_seq_len - T0 + 1)
+    prefill, step = _decode_fns_for(config)
+    cache = init_kv_cache(config, B)
+    logits, cache = prefill(params, jnp.asarray(prompt, jnp.int32), cache)
+    out = [jnp.asarray(prompt, jnp.int32)]
+    for i in range(n):
+        step_key = None
+        if key is not None:
+            key, step_key = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_k, key=step_key)
+        out.append(nxt[:, None])
+        if i + 1 < n:
+            logits, cache = step(params, nxt, jnp.int32(T0 + i), cache)
+    return jnp.concatenate(out, axis=1)
 
 
 def make_train_step(config, optimizer, mesh=None):
