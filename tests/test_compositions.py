@@ -1,0 +1,80 @@
+"""Cross-subsystem compositions — the places where two independently-tested
+features meet (last round's lesson: the bench path was compositionally
+untested)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _ds(n=64, d=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, d).astype('float32')
+    y = (x @ rng.rand(d, classes).astype('float32')).argmax(1).astype('int64')
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return x[i], y[i]
+    return DS()
+
+
+def test_hapi_amp_accum_sched_clip_compose():
+    """Model.fit with AMP O1 + gradient accumulation + cosine schedule +
+    global-norm clip in ONE fused step."""
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=0.01,
+                                                     T_max=8)
+    opt = paddle.optimizer.AdamW(learning_rate=sched, weight_decay=0.01,
+                                 parameters=net.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    m = paddle.Model(net)
+    m.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy(),
+              amp_configs='O1')
+    m.fit(_ds(), epochs=3, batch_size=8, verbose=0,
+          accumulate_grad_batches=2)
+    ev = m.evaluate(_ds(), batch_size=16, verbose=0)
+    assert float(ev['acc']) > 0.4 and np.isfinite(float(ev['loss']))
+
+
+def test_zero3_asp_functional_compose():
+    """ZeRO-3 (FSDP-style GSPMD sharding) + ASP mask re-application inside
+    one jitted step: weights stay 2:4 sparse through sharded updates."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu import sparsity
+
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = {'stage': 3, 'sharding_degree': 8}
+    strategy.asp = True
+    strategy.hybrid_configs = {'dp_degree': 8}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    params = {'w': jax.random.normal(jax.random.PRNGKey(0), (32, 32)),
+              'b': jnp.zeros((32,))}
+    pruned, masks = sparsity.prune_tree(params, 2, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.01)
+    dopt = fleet.distributed_optimizer(opt)
+    dopt.set_asp_masks(masks)
+    state = dopt.functional_init(pruned)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 32))
+
+    @jax.jit
+    def step(p, s, x):
+        def loss_fn(p):
+            return jnp.mean((x @ p['w'] + p['b']) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        p2, s2 = dopt.functional_apply(p, g, s)
+        return loss, p2, s2
+
+    losses = []
+    p, s = pruned, state
+    for _ in range(3):
+        loss, p, s = step(p, s, x)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert sparsity.check_sparsity(np.asarray(p['w']), 'check_1d', 2, 4)
