@@ -106,6 +106,13 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         feed = feed or {}
         program = program or default_main_program()
+        from .extras import _LoadedInferenceProgram
+        if isinstance(program, _LoadedInferenceProgram):
+            vals = program.run(feed)
+            if fetch_list:           # fetch targets are output indices
+                vals = [vals[int(i)] for i in fetch_list]
+            return ([np.asarray(v) for v in vals] if return_numpy
+                    else [Tensor(v) for v in vals])
         fetch_list = fetch_list or []
         feed_names = tuple(sorted(feed.keys()))
         opt_rec = getattr(program, '_opt', None)

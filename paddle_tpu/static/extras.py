@@ -171,34 +171,125 @@ class ParallelExecutor:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    """Serialize the replay program between feed placeholders and fetches.
-    Uses jit-save's format: params pickle + meta json."""
+    """Serialize the replay program between feed placeholders and fetches
+    as a standalone jax.export artifact (the same .pdexec/.pdparams
+    discipline as jit.save — reference: __model__ ProgramDesc + params).
+    Dims declared None/-1 on the feed Variables become symbolic."""
     import json
-    from ..framework_io import save as fsave
+    from jax import export as jax_export
+    from . import Executor
     feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
-    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    fetches = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+               else [fetch_vars])
     os.makedirs(os.path.dirname(path_prefix) or '.', exist_ok=True)
-    import pickle
-    with open(path_prefix + '.replay', 'wb') as f:
-        pickle.dump({'feeds': [v.name for v in feeds],
-                     'fetch_graph': fetches}, f)
+    feed_names = tuple(sorted(v.name for v in feeds))
+    by_name = {v.name: v for v in feeds}
+    exe = executor or Executor()
+    fn, leaves, _ = exe._compile(list(fetches), feed_names, None)
+    leaf_vals = [np.asarray(t._value) for t in leaves]
+    from ..framework_io import save as fsave
+    fsave({'params': {f'leaf{i}': v for i, v in enumerate(leaf_vals)},
+           'buffers': {}}, path_prefix + '.pdparams')
+
+    def spec_of(name):
+        v = by_name[name]
+        return list(getattr(v, 'spec_shape', v.shape))
+
+    def _feed_structs(mode):
+        """mode: 'independent' (one symbol per dynamic dim), 'shared' (one
+        symbol — programs requiring equal dynamic dims), 'concrete'."""
+        n_dyn = sum(1 for n in feed_names
+                    for d in spec_of(n) if d in (None, -1))
+        if mode == 'independent' and n_dyn:
+            syms = iter(jax_export.symbolic_shape(
+                ', '.join(f'b{i}' for i in range(n_dyn))))
+        elif mode == 'shared' and n_dyn:
+            b, = jax_export.symbolic_shape('b')
+            syms = iter([b] * n_dyn)
+        else:
+            syms = iter([])
+            mode = 'concrete'
+        out = []
+        for n in feed_names:
+            v = by_name[n]
+            dims = [next(syms, 1) if d in (None, -1) else int(d)
+                    for d in spec_of(n)]
+            out.append(jax.ShapeDtypeStruct(tuple(dims),
+                                            jnp.dtype(v.dtype)))
+        return out
+
+    leaf_structs = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for v in leaf_vals]
+    # user-facing names keep the CALLER's feed_vars order (reference
+    # contract — positional binding must stay correct); the executable's
+    # argument order is the sorted compile order
     meta = {'feed_names': [v.name for v in feeds],
-            'feed_shapes': [list(v.spec_shape) if hasattr(v, 'spec_shape')
-                            else list(v.shape) for v in feeds]}
+            'feed_order_exec': list(feed_names),
+            'feed_shapes': [spec_of(n) for n in feed_names],
+            'n_fetch': len(fetches), 'exported': False}
+
+    def efn(leaf_list, *feed_arrays):
+        return fn(list(feed_arrays), list(leaf_list))
+
+    for mode in ('independent', 'shared', 'concrete'):
+        try:
+            blob = jax_export.export(jax.jit(efn))(
+                leaf_structs, *_feed_structs(mode)).serialize()
+        except Exception as e:   # noqa: BLE001 — try the next shape mode
+            meta['export_error'] = f'{e.__class__.__name__}: {e}'[:300]
+            continue
+        with open(path_prefix + '.pdexec', 'wb') as f:
+            f.write(blob)
+        meta.update(exported=True, poly_batch=mode != 'concrete')
+        meta.pop('export_error', None)
+        break
     with open(path_prefix + '.pdmodel', 'w') as f:
         json.dump(meta, f)
+    if not meta['exported']:
+        # never leave a stale executable that a later load would pair with
+        # the new params
+        if os.path.exists(path_prefix + '.pdexec'):
+            os.unlink(path_prefix + '.pdexec')
+        raise RuntimeError('save_inference_model: program export failed: '
+                           + meta.get('export_error', 'unknown'))
+
+
+class _LoadedInferenceProgram:
+    """Deserialized standalone program; Executor.run detects and calls it."""
+
+    def __init__(self, path_prefix):
+        import json
+        from jax import export as jax_export
+        from ..framework_io import load as fload
+        with open(path_prefix + '.pdmodel') as f:
+            self.meta = json.load(f)
+        if not self.meta.get('exported'):
+            raise RuntimeError(
+                f'{path_prefix}.pdmodel records a FAILED export '
+                f'({self.meta.get("export_error", "unknown")}) — re-run '
+                'save_inference_model')
+        state = fload(path_prefix + '.pdparams')
+        self._leaves = [jnp.asarray(getattr(v, '_value', v))
+                        for _, v in sorted(
+                            state['params'].items(),
+                            key=lambda kv: int(kv[0][4:]))]
+        with open(path_prefix + '.pdexec', 'rb') as f:
+            self._exec = jax_export.deserialize(f.read())
+        self.feed_names = self.meta['feed_names']          # caller order
+        self._exec_order = self.meta.get('feed_order_exec',
+                                         sorted(self.feed_names))
+
+    def run(self, feed):
+        args = [jnp.asarray(np.asarray(feed[n])) for n in self._exec_order]
+        return list(self._exec.call(self._leaves, *args))
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    import pickle
-    with open(path_prefix + '.replay', 'rb') as f:
-        blob = pickle.load(f)
-    import json
-    with open(path_prefix + '.pdmodel') as f:
-        meta = json.load(f)
-    from . import Program
-    program = Program()
-    return program, meta['feed_names'], blob['fetch_graph']
+    """-> (program, feed_target_names, fetch_targets). The program is a
+    standalone executable; run it with Executor.run(program, feed=...,
+    fetch_list=fetch_targets)."""
+    prog = _LoadedInferenceProgram(path_prefix)
+    return prog, list(prog.feed_names), list(range(prog.meta['n_fetch']))
 
 
 def serialize_program(feed_vars, fetch_vars, **kwargs):

@@ -326,3 +326,34 @@ def test_static_clone_for_test_never_trains():
         assert float(np.asarray(t2)) < float(np.asarray(t1))
     finally:
         paddle.disable_static()
+
+
+def test_static_save_load_inference_model_journey(tmp_path):
+    """The 1.x deployment workflow: save_inference_model exports a
+    standalone program (jax.export, symbolic batch); load_inference_model
+    in a fresh Executor serves any batch with identical outputs."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data('x', [None, 6], 'float32')
+            out = paddle.static.nn.fc(
+                paddle.static.nn.fc(x, 8, activation='relu'), 3)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(4, 6).astype('float32')
+        want, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+        prefix = os.path.join(str(tmp_path), 'model')
+        paddle.static.save_inference_model(prefix, [x], [out], exe)
+    finally:
+        paddle.disable_static()
+    prog, feed_names, fetch = paddle.static.load_inference_model(prefix)
+    exe2 = paddle.static.Executor()
+    for b in (1, 7):
+        r, = exe2.run(prog, feed={feed_names[0]:
+                                  np.random.rand(b, 6).astype('float32')},
+                      fetch_list=fetch)
+        assert np.asarray(r).shape == (b, 3)
+    got, = exe2.run(prog, feed={'x': xv}, fetch_list=fetch)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5)
