@@ -110,7 +110,10 @@ def encode(params, tokens, token_type=None, attn_mask=None, config=None):
 
     body = partial(_block, mask_bias=bias, config=config)
     if config.remat:
-        body = jax.checkpoint(lambda bp, xx: body(bp, xx))
+        # NOTE: wrapping in `lambda bp, xx: body(bp, xx)` here recursed
+        # forever — the lambda closed over the NAME `body`, which this
+        # assignment rebinds to the checkpointed lambda itself
+        body = jax.checkpoint(body)
 
     def scan_body(c, bp):
         return body(bp, c), None

@@ -197,7 +197,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     def _feed_structs(mode):
         """mode: 'independent' (one symbol per dynamic dim), 'shared' (one
-        symbol — programs requiring equal dynamic dims), 'concrete'."""
+        symbol — programs requiring equal dynamic dims), 'concrete'.
+        Returns (structs, effective_mode) — no dynamic dims degrade to
+        'concrete' regardless of the requested mode."""
         n_dyn = sum(1 for n in feed_names
                     for d in spec_of(n) if d in (None, -1))
         if mode == 'independent' and n_dyn:
@@ -216,31 +218,37 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                     for d in spec_of(n)]
             out.append(jax.ShapeDtypeStruct(tuple(dims),
                                             jnp.dtype(v.dtype)))
-        return out
+        return out, mode
 
     leaf_structs = [jax.ShapeDtypeStruct(v.shape, v.dtype)
                     for v in leaf_vals]
-    # user-facing names keep the CALLER's feed_vars order (reference
-    # contract — positional binding must stay correct); the executable's
-    # argument order is the sorted compile order
+    # user-facing names/shapes keep the CALLER's feed_vars order (reference
+    # contract — positional binding and name/shape zipping must stay
+    # correct); the executable's argument order/dtypes are recorded in the
+    # parallel *_exec lists
     meta = {'feed_names': [v.name for v in feeds],
+            'feed_shapes': [spec_of(v.name) for v in feeds],
             'feed_order_exec': list(feed_names),
-            'feed_shapes': [spec_of(n) for n in feed_names],
+            'feed_dtypes_exec': [str(jnp.dtype(by_name[n].dtype))
+                                 for n in feed_names],
             'n_fetch': len(fetches), 'exported': False}
 
     def efn(leaf_list, *feed_arrays):
         return fn(list(feed_arrays), list(leaf_list))
 
     for mode in ('independent', 'shared', 'concrete'):
+        structs, effective = _feed_structs(mode)
         try:
             blob = jax_export.export(jax.jit(efn))(
-                leaf_structs, *_feed_structs(mode)).serialize()
+                leaf_structs, *structs).serialize()
         except Exception as e:   # noqa: BLE001 — try the next shape mode
             meta['export_error'] = f'{e.__class__.__name__}: {e}'[:300]
+            if effective == 'concrete':
+                break            # later modes would be identical
             continue
         with open(path_prefix + '.pdexec', 'wb') as f:
             f.write(blob)
-        meta.update(exported=True, poly_batch=mode != 'concrete')
+        meta.update(exported=True, poly_batch=effective != 'concrete')
         meta.pop('export_error', None)
         break
     with open(path_prefix + '.pdmodel', 'w') as f:
@@ -261,6 +269,10 @@ class _LoadedInferenceProgram:
         import json
         from jax import export as jax_export
         from ..framework_io import load as fload
+        if os.path.exists(path_prefix + '.replay'):
+            raise RuntimeError(
+                f'{path_prefix} was saved by an older save_inference_model '
+                'format (.replay); re-save with the current version')
         with open(path_prefix + '.pdmodel') as f:
             self.meta = json.load(f)
         if not self.meta.get('exported'):
@@ -278,9 +290,14 @@ class _LoadedInferenceProgram:
         self.feed_names = self.meta['feed_names']          # caller order
         self._exec_order = self.meta.get('feed_order_exec',
                                          sorted(self.feed_names))
+        self._exec_dtypes = self.meta.get(
+            'feed_dtypes_exec', ['float32'] * len(self._exec_order))
 
     def run(self, feed):
-        args = [jnp.asarray(np.asarray(feed[n])) for n in self._exec_order]
+        # cast to the placeholder dtype like Executor.run's replay does —
+        # the exported executable's avals are fixed
+        args = [jnp.asarray(np.asarray(feed[n])).astype(dt)
+                for n, dt in zip(self._exec_order, self._exec_dtypes)]
         return list(self._exec.call(self._leaves, *args))
 
 

@@ -132,3 +132,31 @@ def test_ppyolo_lite_decode():
     from paddle_tpu.vision.ops import nms
     keep = nms(boxes[0], 0.5, scores[0].max(axis=-1))
     assert keep.ndim == 1
+
+
+def test_ernie_finetune_with_remat():
+    """Classifier fine-tuning over the ERNIE encoder WITH remat on
+    (regression: the checkpoint wrapper recursed on its own name)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import ernie
+
+    cfg = ernie.ErnieConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=24, remat=True)
+    enc = ernie.ErnieModel(cfg)
+    head = nn.Linear(32, 2)
+    toks = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 256, (4, 16)).astype('int64'))
+    labels = paddle.to_tensor(np.array([0, 1, 0, 1], 'int64'))
+    opt = paddle.optimizer.AdamW(
+        1e-3, parameters=list(enc.parameters()) + list(head.parameters()))
+    losses = []
+    for _ in range(4):
+        out = enc(toks)
+        seq = out[0] if isinstance(out, (tuple, list)) else out
+        feats = seq[:, 0] if seq.ndim == 3 else seq
+        loss = F.cross_entropy(head(feats), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
