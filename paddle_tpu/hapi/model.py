@@ -78,9 +78,35 @@ class Model:
             loss = total
         return loss._value if isinstance(loss, Tensor) else loss
 
+    def _asp_masks_by_name(self):
+        """ASP masks for this network's params keyed by name (None when
+        none registered) — the fused functional step bypasses the eager
+        optimizer.step that sparsity.decorate wraps, so mask re-application
+        is traced into the step itself."""
+        from ..sparsity import ASPHelper
+        masks = {}
+        for n, p in self.network.named_parameters():
+            ent = ASPHelper._masks.get(id(p))
+            # the registry keys by id(param): a reused id from a dead
+            # parameter must not map a stale mask onto this one
+            if ent is not None and ent[0]() is p:
+                masks[n] = ent[1]
+        return masks or None
+
+    def _asp_signature(self):
+        m = self._asp_masks_by_name()
+        return tuple(sorted(m)) if m else None
+
     def _build_train_step(self):
         net = self.network
         opt = self._optimizer
+        asp_masks = self._asp_masks_by_name()
+
+        def remask(params):
+            if asp_masks is None:
+                return params
+            return {n: (v * asp_masks[n] if n in asp_masks else v)
+                    for n, v in params.items()}
 
         def set_mode(training):
             for l in net.sublayers(include_self=True):
@@ -100,7 +126,7 @@ class Model:
                 params, buffers, key, inputs, labels)
             new_params, new_state = opt.functional_apply(params, grads,
                                                          opt_state, lr)
-            return loss, out, new_params, new_buf, new_state
+            return loss, out, remask(new_params), new_buf, new_state
 
         def accum_step(params, buffers, grad_acc, key, inputs, labels):
             """Gradient-merge micro-step: accumulate grads, no update.
@@ -112,7 +138,8 @@ class Model:
 
         def apply_accum(params, opt_state, grad_acc, lr, scale):
             grads = jax.tree_util.tree_map(lambda g: g * scale, grad_acc)
-            return opt.functional_apply(params, grads, opt_state, lr)
+            new_p, new_s = opt.functional_apply(params, grads, opt_state, lr)
+            return remask(new_p), new_s
 
         self._accum_step = jax.jit(accum_step)
         self._apply_accum = jax.jit(apply_accum)
@@ -146,7 +173,13 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         from ..distributed.launch import touch_heartbeat
         touch_heartbeat()   # liveness signal for the elastic launcher
+        if self._train_step is not None and \
+                getattr(self, '_asp_sig', None) != self._asp_signature():
+            # prune_model after a warmup fit (the standard ASP recipe):
+            # rebuild so the new masks trace into the step
+            self._train_step = None
         if self._train_step is None:
+            self._asp_sig = self._asp_signature()
             self._train_step = self._build_train_step()
             self._opt_state = self._optimizer.functional_init(self._params_dict())
         inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))

@@ -184,6 +184,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     os.makedirs(os.path.dirname(path_prefix) or '.', exist_ok=True)
     feed_names = tuple(sorted(v.name for v in feeds))
     by_name = {v.name: v for v in feeds}
+    if os.path.exists(path_prefix + '.replay'):
+        os.unlink(path_prefix + '.replay')   # pre-rewrite format leftover:
+        # the loader's old-format guard must not outlive a re-save
     exe = executor or Executor()
     fn, leaves, _ = exe._compile(list(fetches), feed_names, None)
     leaf_vals = [np.asarray(t._value) for t in leaves]
@@ -291,12 +294,14 @@ class _LoadedInferenceProgram:
         self._exec_order = self.meta.get('feed_order_exec',
                                          sorted(self.feed_names))
         self._exec_dtypes = self.meta.get(
-            'feed_dtypes_exec', ['float32'] * len(self._exec_order))
+            'feed_dtypes_exec', [None] * len(self._exec_order))
 
     def run(self, feed):
         # cast to the placeholder dtype like Executor.run's replay does —
-        # the exported executable's avals are fixed
-        args = [jnp.asarray(np.asarray(feed[n])).astype(dt)
+        # the exported executable's avals are fixed. No recorded dtype
+        # (older artifact): pass through uncast.
+        args = [jnp.asarray(np.asarray(feed[n])) if dt is None
+                else jnp.asarray(np.asarray(feed[n])).astype(dt)
                 for n, dt in zip(self._exec_order, self._exec_dtypes)]
         return list(self._exec.call(self._leaves, *args))
 

@@ -78,3 +78,25 @@ def test_zero3_asp_functional_compose():
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     assert sparsity.check_sparsity(np.asarray(p['w']), 'check_1d', 2, 4)
+
+
+def test_hapi_fit_keeps_asp_sparsity():
+    """hapi's FUSED functional train step must re-apply ASP masks — it
+    bypasses the eager optimizer.step that sparsity.decorate wraps."""
+    from paddle_tpu import sparsity
+
+    sparsity.ASPHelper.reset()
+    try:
+        net = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 2))
+        opt = sparsity.decorate(paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()))
+        masks = sparsity.prune_model(net)
+        m = paddle.Model(net)
+        m.prepare(opt, nn.CrossEntropyLoss())
+        m.fit(_ds(d=16, classes=2), epochs=2, batch_size=8, verbose=0)
+        for name, p in net.named_parameters():
+            if name in masks:
+                assert sparsity.check_sparsity(np.asarray(p._value),
+                                               'check_1d', 2, 4), name
+    finally:
+        sparsity.ASPHelper.reset()
