@@ -320,7 +320,14 @@ class Model:
             first = out[0] if isinstance(out, (list, tuple)) else out
         for m in self._metrics:
             res = m.compute(Tensor(first), Tensor(labels[0]))
-            acc = m.update(res)
+            # reference contract: a tuple-returning compute() is UNPACKED
+            # into update(*results)
+            acc = m.update(*(res if isinstance(res, (list, tuple))
+                             else (res,)))
+            if acc is None:
+                # Precision/Recall/Auc-style updates return nothing; the
+                # running value comes from accumulate()
+                acc = m.accumulate()
             names = m.name() if isinstance(m.name(), list) else [m.name()]
             vals = acc if isinstance(acc, list) else [acc]
             for n, v in zip(names, vals):
@@ -341,7 +348,8 @@ class Model:
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 for m in self._metrics:
                     res = m.compute(Tensor(outs[0]), Tensor(labels[0]))
-                    m.update(res)
+                    m.update(*(res if isinstance(res, (list, tuple))
+                               else (res,)))
         logs = {}
         if losses:
             logs['loss'] = float(np.mean([np.asarray(l) for l in losses]))

@@ -405,3 +405,82 @@ def test_onnx_export_writes_portable_artifacts(tmp_path):
     base = os.path.join(str(tmp_path), 'm')
     assert os.path.exists(base + '.stablehlo')
     assert os.path.exists(base + '.pdexec')
+
+
+def test_custom_metric_tuple_compute():
+    """A user Metric whose compute() returns (pred, label) must have the
+    tuple UNPACKED into update(*results) — the reference hapi contract."""
+    import paddle_tpu.nn as nn
+
+    class F1(paddle.metric.Metric):
+        def __init__(self):
+            super().__init__()
+            self.reset()
+
+        def name(self):
+            return 'f1'
+
+        def compute(self, pred, label):
+            return pred, label
+
+        def update(self, preds, labels):
+            p = np.asarray(preds).argmax(-1).astype(int)
+            l = np.asarray(labels).reshape(-1).astype(int)
+            self.tp += int(((p == 1) & (l == 1)).sum())
+            self.fp += int(((p == 1) & (l == 0)).sum())
+            self.fn += int(((p == 0) & (l == 1)).sum())
+            return self.accumulate()
+
+        def accumulate(self):
+            pr = self.tp / max(self.tp + self.fp, 1)
+            rc = self.tp / max(self.tp + self.fn, 1)
+            return 2 * pr * rc / max(pr + rc, 1e-9)
+
+        def reset(self):
+            self.tp = self.fp = self.fn = 0
+
+    x = np.random.RandomState(0).rand(32, 8).astype('float32')
+    y = (x.sum(1) > 4).astype('int64')
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return x[i], y[i]
+
+    m = paddle.Model(nn.Sequential(nn.Linear(8, 2)))
+    m.prepare(paddle.optimizer.Adam(0.05, parameters=m.parameters()),
+              nn.CrossEntropyLoss(), F1())
+    m.fit(DS(), epochs=2, batch_size=8, verbose=0)
+    ev = m.evaluate(DS(), batch_size=16, verbose=0)
+    assert 'f1' in ev and 0.0 <= float(ev['f1']) <= 1.0
+
+
+def test_builtin_precision_recall_auc_in_fit():
+    """Precision/Recall/Auc (update() returns None) must log through
+    accumulate() during fit, not crash on float(None)."""
+    import paddle_tpu.nn as nn
+    x = np.random.RandomState(1).rand(32, 8).astype('float32')
+    y = (x.sum(1) > 4).astype('int64')
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return x[i], y[i]
+
+    m = paddle.Model(nn.Sequential(nn.Linear(8, 1)))
+
+    class BCE(nn.Layer):
+        def forward(self, logit, label):
+            import paddle_tpu.nn.functional as F
+            return F.binary_cross_entropy_with_logits(
+                logit.squeeze(-1), label.astype('float32'))
+
+    m.prepare(paddle.optimizer.Adam(0.05, parameters=m.parameters()),
+              BCE(), [paddle.metric.Precision(), paddle.metric.Recall()])
+    m.fit(DS(), epochs=1, batch_size=8, verbose=0)
+    ev = m.evaluate(DS(), batch_size=16, verbose=0)
+    assert 'precision' in ev and 'recall' in ev
