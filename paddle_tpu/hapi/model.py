@@ -94,8 +94,10 @@ class Model:
         return masks or None
 
     def _asp_signature(self):
+        # mask IDENTITY, not just names: re-pruning the same params installs
+        # new mask arrays that must force a train-step rebuild (advisor r3)
         m = self._asp_masks_by_name()
-        return tuple(sorted(m)) if m else None
+        return tuple(sorted((n, id(v)) for n, v in m.items())) if m else None
 
     def _build_train_step(self):
         net = self.network

@@ -506,9 +506,16 @@ class _BreakContinueTransformer(ast.NodeTransformer):
                 if isinstance(st, (ast.Break, ast.Continue)):
                     return True
                 if isinstance(st, (ast.For, ast.While)):
+                    # the inner loop owns break/continue in its BODY, but its
+                    # for/while-else block binds to THIS loop
+                    if scan(st.orelse or []):
+                        return True
                     continue
                 for attr in ('body', 'orelse', 'finalbody'):
                     if scan(getattr(st, attr, []) or []):
+                        return True
+                for h in getattr(st, 'handlers', []) or []:
+                    if scan(h.body):       # except-blocks can break/continue
                         return True
             return False
         return scan(stmts)
@@ -527,7 +534,12 @@ class _BreakContinueTransformer(ast.NodeTransformer):
                 return out, True
             found = False
             if isinstance(st, (ast.For, ast.While)):
-                pass                   # inner loop owns its break/continue
+                # inner loop owns its break/continue — but its else-block
+                # runs in THIS loop's scope
+                if st.orelse:
+                    new, f = self._guard(st.orelse, fb, fc)
+                    st.orelse = new
+                    found = found or f
             elif isinstance(st, (ast.If, ast.With, ast.Try)):
                 for attr in ('body', 'orelse', 'finalbody'):
                     blk = getattr(st, attr, None)
@@ -535,6 +547,10 @@ class _BreakContinueTransformer(ast.NodeTransformer):
                         new, f = self._guard(blk, fb, fc)
                         setattr(st, attr, new)
                         found = found or f
+                for h in getattr(st, 'handlers', []) or []:
+                    new, f = self._guard(h.body, fb, fc)
+                    h.body = new
+                    found = found or f
             out.append(st)
             if found:
                 rest, _ = self._guard(stmts[i + 1:], fb, fc)

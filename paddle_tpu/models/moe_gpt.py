@@ -223,6 +223,13 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
             'KV cache cannot hold it — truncate the prompt or raise '
             'max_seq_len')
     n = min(max_new_tokens, config.max_seq_len - T0 + 1)
+    if n < max_new_tokens:
+        import warnings
+        warnings.warn(
+            f'generate: max_new_tokens={max_new_tokens} exceeds the KV-cache '
+            f'window (max_seq_len={config.max_seq_len}, prompt={T0}); only '
+            f'{n} tokens will be generated. Raise max_seq_len or use '
+            'gpt.GPTForCausalLM.generate for sliding-window continuation.')
     prefill, step = _decode_fns_for(config)
     cache = init_kv_cache(config, B)
     logits, cache = prefill(params, jnp.asarray(prompt, jnp.int32), cache)

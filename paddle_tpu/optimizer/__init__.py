@@ -79,8 +79,11 @@ class AdamW(Adam):
     _decoupled = True
 
     def _decoupled_coeff(self, wd):
-        if wd is None:
+        from .optimizer import _MISSING
+        if wd is _MISSING:          # group has no override: optimizer default
             return self._coeff
+        if wd is None:              # explicit None: group exempt from decay
+            return 0.0
         from ..regularizer import L2Decay
         if isinstance(wd, L2Decay):
             return wd._coeff

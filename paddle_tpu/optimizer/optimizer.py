@@ -17,6 +17,12 @@ from ..nn.layer_base import Parameter
 from ..nn.clip import ClipGradBase
 from . import lr as lr_mod
 
+# Sentinel for 'key absent from the param group': distinguishes a group that
+# INHERITS the optimizer-level weight decay from one that explicitly overrides
+# it with None (= exempt from decay). Reference semantics: an explicit None in
+# a group entry is an override, not an inherit.
+_MISSING = object()
+
 
 class Optimizer:
     _decoupled = False       # AdamW-style weight decay (set by subclasses)
@@ -92,11 +98,12 @@ class Optimizer:
             return self._weight_decay._coeff
         return 0.0
 
-    def _apply_decay(self, g, p, wd=None):
+    def _apply_decay(self, g, p, wd=_MISSING):
         """L2 regularization folded into grad (paddle semantics: regularizer
-        adds coeff*p to the gradient; AdamW instead decays weights directly)."""
+        adds coeff*p to the gradient; AdamW instead decays weights directly).
+        wd=_MISSING inherits the optimizer default; an explicit None exempts."""
         from ..regularizer import L1Decay, L2Decay
-        if wd is None:
+        if wd is _MISSING:
             wd = self._weight_decay
         if isinstance(wd, L2Decay):
             return g + wd._coeff * p
@@ -127,11 +134,15 @@ class Optimizer:
             states = [self._states[id(p)] for p in params]
             def _of(key, default):
                 return group[key] if group and key in group else default
-            lr = jnp.asarray(_of('learning_rate', None)
-                             if _of('learning_rate', None) is not None
-                             else self.get_lr(), jnp.float32)
+            # Reference semantics (optimizer.py _create_param_lr): a group
+            # 'learning_rate' is a SCALE of the base rate (so an LRScheduler
+            # on the base still drives every group), not an absolute LR.
+            scale = _of('learning_rate', 1.0)
+            lr = jnp.asarray(
+                self.get_lr() * (1.0 if scale is None else float(scale)),
+                jnp.float32)
             clip = _of('grad_clip', self._grad_clip)
-            wd = _of('weight_decay', self._weight_decay)
+            wd = _of('weight_decay', _MISSING)
 
             new_vals, new_states = self._fused_apply(
                 gi, clip, wd)(grads, vals, states, lr)
@@ -240,7 +251,8 @@ class Optimizer:
                 continue
             g = g.astype(p.dtype)
             if self._decoupled:
-                p = p * (1 - lr.astype(p.dtype) * self._decoupled_coeff(None))
+                p = p * (1 - lr.astype(p.dtype)
+                         * self._decoupled_coeff(_MISSING))
             else:
                 g = self._apply_decay(g, p)
             np_, ns_ = self._update(g, p, s, lr)

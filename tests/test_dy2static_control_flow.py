@@ -591,3 +591,45 @@ def test_zero_trip_break_for_keeps_prior_target():
         return x * 0 + i
 
     assert float(f(paddle.to_tensor(np.float32(0.0)))) == 99.0
+
+
+def test_break_inside_except_block():
+    """break/continue inside an except handler must be seen by the
+    flag-lowering pre-pass (advisor r3: Try.handlers was skipped)."""
+    @paddle.jit.to_static
+    def f(x, limit):
+        total = x * 0
+        i = x * 0
+        while True:
+            try:
+                total = total + i
+                raise RuntimeError('hop')
+            except RuntimeError:
+                i = i + 1
+                if i >= limit:
+                    break
+        return total
+
+    out = f(paddle.to_tensor(np.float32(0.0)),
+            paddle.to_tensor(np.float32(4.0)))
+    assert float(out) == float(sum(range(4)))
+
+
+def test_break_in_inner_for_else_binds_outer_loop():
+    """A break in an inner loop's else-block binds to the OUTER loop
+    (review r4 finding: _block_has_bc/_guard skipped inner-loop orelse)."""
+    @paddle.jit.to_static
+    def f(x, limit):
+        total = x * 0
+        i = 0
+        while True:
+            for i in range(2):
+                total = total + 1
+            else:
+                if total >= limit:
+                    break
+        return total
+
+    out = f(paddle.to_tensor(np.float32(0.0)),
+            paddle.to_tensor(np.float32(5.0)))
+    assert float(out) == 6.0

@@ -126,24 +126,22 @@ def test_gradscaler():
 
 def test_parameter_groups():
     """Reference feature: parameters as a list of dicts with per-group
-    learning_rate / weight_decay / grad_clip overrides."""
-    import paddle_tpu.nn as nn
-    rng = np.random.RandomState(0)
-    l1, l2 = nn.Linear(4, 4), nn.Linear(4, 2)
-    w1_before = np.asarray(l1.weight._value).copy()
-    w2_before = np.asarray(l2.weight._value).copy()
-    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=[
-        {'params': l1.parameters(), 'learning_rate': 0.5},
-        {'params': l2.parameters()},                 # inherits global lr 0.0
+    overrides. A group 'learning_rate' is a SCALE of the base rate
+    (reference optimizer.py _create_param_lr: base 0.1 + group 0.5 =>
+    effective 0.05), so schedulers on the base rate drive every group."""
+    from paddle_tpu.nn.layer_base import Parameter
+    p1 = Parameter(np.ones(4, 'float32'))
+    p2 = Parameter(np.ones(4, 'float32'))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[
+        {'params': [p1], 'learning_rate': 0.5},      # effective 0.05
+        {'params': [p2]},                            # inherits base 0.1
     ])
-    x = paddle.to_tensor(rng.rand(3, 4).astype('float32'))
-    loss = (l2(l1(x)) ** 2).mean()
+    loss = (p1.sum() + p2.sum())                     # grad = 1 for both
     loss.backward()
     opt.step()
     opt.clear_grad()
-    # group 1 moved (lr 0.5), group 2 frozen (global lr 0.0)
-    assert not np.allclose(np.asarray(l1.weight._value), w1_before)
-    np.testing.assert_array_equal(np.asarray(l2.weight._value), w2_before)
+    np.testing.assert_allclose(np.asarray(p1._value), 1 - 0.05, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2._value), 1 - 0.1, atol=1e-6)
 
 
 def test_parameter_groups_weight_decay():
@@ -188,6 +186,36 @@ def test_adamw_group_decay_exemption():
     # exempt group untouched by decay; decayed group = w * (1 - lr*coeff)
     np.testing.assert_allclose(w2, np.asarray(l2.weight._value))
     np.testing.assert_allclose(w1, w2 * (1 - 0.1 * 0.5), rtol=1e-6)
+
+
+def test_none_group_decay_is_an_override():
+    """An explicit 'weight_decay': None in a group EXEMPTS it from decay
+    (must not silently fall back to the optimizer default — advisor r3)."""
+    import paddle_tpu.nn as nn
+    l1, l2 = nn.Linear(4, 4, bias_attr=False), nn.Linear(4, 4, bias_attr=False)
+    b1 = np.asarray(l1.weight._value).copy()
+    b2 = np.asarray(l2.weight._value).copy()
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[{'params': l1.parameters(),
+                                              'weight_decay': None},
+                                             {'params': l2.parameters()}])
+    for l in (l1, l2):
+        (l(paddle.to_tensor(np.zeros((2, 4), 'float32'))) * 0).sum().backward()
+    opt.step()
+    # zero grads: the None group is untouched, the default group decayed
+    np.testing.assert_array_equal(np.asarray(l1.weight._value), b1)
+    np.testing.assert_allclose(np.asarray(l2.weight._value),
+                               b2 * (1 - 0.1 * 0.5), rtol=1e-6)
+
+    # same override through the SGD L2-fold path
+    l3 = nn.Linear(4, 4, bias_attr=False)
+    b3 = np.asarray(l3.weight._value).copy()
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, weight_decay=0.01,
+                                parameters=[{'params': l3.parameters(),
+                                             'weight_decay': None}])
+    (l3(paddle.to_tensor(np.zeros((2, 4), 'float32'))) * 0).sum().backward()
+    opt2.step()
+    np.testing.assert_array_equal(np.asarray(l3.weight._value), b3)
 
 
 def test_int_zero_group_decay_is_an_override():
