@@ -268,15 +268,28 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
     B, T, h = x.shape
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    S = k_cache.shape[1]
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache) * scale      # [B,H,T,S]
-    q_pos = pos + jnp.arange(T)[:, None]                        # [T,1]
-    k_pos = jnp.arange(S)[None, :]                              # [1,S]
-    s = jnp.where((k_pos <= q_pos)[None, None], s.astype(jnp.float32),
-                  jnp.float32(-1e30))
-    p = jax.nn.softmax(s, axis=-1).astype(cdt)
-    a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache).reshape(B, T, h)
+    from ..ops.flash_attention import (
+        flash_attention, flash_attention_available, flash_decode,
+        flash_decode_available)
+    if (isinstance(pos, int) and pos == 0
+            and flash_attention_available(q, k, v, None)):
+        # prefill at a STATIC position 0: attention over the cache equals
+        # causal self-attention over the fresh k/v (later cache rows are
+        # masked out anyway) — run the main flash kernel
+        a = flash_attention(q, k, v, causal=True).reshape(B, T, h)
+    elif flash_decode_available(q, k_cache):
+        # pallas decode kernel: streams only cache blocks up to ``pos``
+        a = flash_decode(q, k_cache, v_cache, pos).reshape(B, T, h)
+    else:
+        S = k_cache.shape[1]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache) * scale  # [B,H,T,S]
+        q_pos = pos + jnp.arange(T)[:, None]                    # [T,1]
+        k_pos = jnp.arange(S)[None, :]                          # [1,S]
+        s = jnp.where((k_pos <= q_pos)[None, None], s.astype(jnp.float32),
+                      jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1).astype(cdt)
+        a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache).reshape(B, T, h)
     return (x + a @ proj_w.astype(cdt) + proj_b.astype(cdt),
             k_cache, v_cache)
 

@@ -21,7 +21,10 @@ def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
         cm = jnp.tril(jnp.ones((qlen, klen), jnp.bool_), k=klen - qlen)
         scores = jnp.where(cm, scores, jnp.asarray(-1e30, scores.dtype))
     if mask is not None:
-        mask = jnp.asarray(mask)
+        # same rank-lift rule as the flash path (key-padding masks broadcast
+        # over heads/queries), so model code behaves identically either way
+        from ...ops.flash_attention import lift_mask_4d
+        mask = lift_mask_4d(mask)
         if mask.dtype == jnp.bool_:
             scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
         else:
@@ -43,5 +46,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         use_flash = False
     if use_flash:
         from ...ops.flash_attention import flash_attention
-        return flash_attention(query, key, value, causal=is_causal)
+        return flash_attention(query, key, value, causal=is_causal,
+                               mask=attn_mask)
     return _sdpa_xla(query, key, value, mask=attn_mask, causal=is_causal)

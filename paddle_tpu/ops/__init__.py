@@ -1,2 +1,4 @@
 """Custom TPU kernels (Pallas)."""
-from .flash_attention import flash_attention, flash_attention_available  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_available, flash_decode,
+    flash_decode_available)

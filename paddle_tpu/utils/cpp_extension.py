@@ -11,7 +11,10 @@ import os
 import subprocess
 import sysconfig
 
-__all__ = ['load', 'CppExtension', 'get_build_directory']
+from .op_extension import get_op, register_op, registered_ops  # noqa: F401
+
+__all__ = ['load', 'CppExtension', 'get_build_directory',
+           'register_op', 'get_op', 'registered_ops']
 
 _BUILD_ROOT = os.path.expanduser('~/.cache/paddle_tpu/extensions')
 

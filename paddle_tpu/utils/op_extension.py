@@ -1,0 +1,96 @@
+"""First-class custom DEVICE ops (the TPU-native analogue of the reference's
+C++/CUDA custom-op mechanism, python/paddle/utils/cpp_extension/
+cpp_extension.py:1 + PD_BUILD_OP).
+
+Where the reference has users compile a C++/CUDA kernel and register it with
+the operator registry, the TPU compute path is XLA/Pallas — a custom op here
+is a PURE jax function (jnp/lax, or a pallas kernel for hand-tiled TPU code),
+optionally with a custom VJP. ``register_op`` makes it a first-class paddle
+op:
+
+ - **eager**: called with paddle Tensors it routes through the dispatch
+   layer, so ``.backward()`` sees it on the tape (custom VJPs are honored —
+   the tape replays through ``jax.vjp`` which respects ``jax.custom_vjp``);
+ - **jit / to_static**: the same pure function traces into the compiled
+   step like any built-in op;
+ - **save/load**: programs containing the op serialize to StableHLO via
+   ``jit.save`` — the op's lowering travels WITH the artifact, so (unlike
+   the reference's .so) a loaded ``.pdexec`` needs no re-registration.
+
+Example (fused custom op with a custom backward)::
+
+    import paddle_tpu as paddle
+
+    def fused_swish(x, beta):                  # pure jnp/lax/pallas body
+        return x * jax.nn.sigmoid(beta * x)
+
+    def fused_swish_fwd(x, beta):
+        out = fused_swish(x, beta)
+        return out, (x, beta, out)
+
+    def fused_swish_bwd(res, g):
+        x, beta, out = res
+        sig = jax.nn.sigmoid(beta * x)
+        dx = g * (sig + beta * out * (1 - sig))
+        dbeta = (g * x * out * (1 - sig)).sum()
+        return dx, dbeta
+
+    swish = paddle.utils.cpp_extension.register_op(
+        'fused_swish', fused_swish,
+        vjp=(fused_swish_fwd, fused_swish_bwd))
+    y = swish(paddle.to_tensor(...), paddle.to_tensor(0.5))   # eager, taped
+
+A pallas kernel body works the same way — write the ``pl.pallas_call`` in
+the forward (see paddle_tpu/ops/flash_attention.py for the house style) and
+register it here.
+"""
+import jax
+
+from ..core import dispatch
+
+_REGISTRY = {}
+
+
+def register_op(name, fn=None, vjp=None, nondiff_argnums=()):
+    """Register ``fn(*arrays, **static) -> array(s)`` as a paddle op.
+
+    name: registry key (also the eager op's __name__).
+    fn: pure jax function (jnp/lax/pallas). May also be used as a decorator:
+        ``@register_op('my_op')``.
+    vjp: optional custom backward — either a ``(fwd, bwd)`` pair with
+        jax.custom_vjp semantics (fwd returns ``(out, residuals)``, bwd
+        returns input cotangents), or an already-built
+        ``jax.custom_vjp``-wrapped callable passed as ``fn`` with vjp=None.
+    nondiff_argnums: static positional args (forwarded to jax.custom_vjp).
+
+    Returns the eager-callable op (also retrievable via ``get_op(name)``).
+    The op accepts paddle Tensors or raw arrays; with Tensor inputs that
+    require grad it records a tape node exactly like built-in ops.
+    """
+    if fn is None:                      # decorator form
+        return lambda f: register_op(name, f, vjp=vjp,
+                                     nondiff_argnums=nondiff_argnums)
+    pure = fn
+    if vjp is not None:
+        fwd, bwd = vjp
+        pure = jax.custom_vjp(fn, nondiff_argnums=tuple(nondiff_argnums))
+        pure.defvjp(fwd, bwd)
+    pure.__name__ = name
+    wrapped = dispatch.op(pure)
+    wrapped.__name__ = name
+    _REGISTRY[name] = wrapped
+    return wrapped
+
+
+def get_op(name):
+    """Look up a previously registered custom op by name."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f'custom op {name!r} is not registered (known: '
+            f'{sorted(_REGISTRY)}); call register_op first') from None
+
+
+def registered_ops():
+    return dict(_REGISTRY)

@@ -104,9 +104,19 @@ def test_bfloat16_forward():
 def test_availability_gate():
     q = jnp.zeros((1, 512, 2, 64))
     assert fa.flash_attention_available(q, q, q, None)       # interpret on
-    assert not fa.flash_attention_available(q, q, q, jnp.ones(1))  # mask
-    bad = jnp.zeros((1, 200, 2, 64))                         # 200 % 256 != 0
-    assert not fa.flash_attention_available(bad, bad, bad, None)
+    # r4: key-padding masks and non-multiple-of-256 seqs are now in-gate
+    assert fa.flash_attention_available(q, q, q, jnp.ones((1, 512), bool))
+    odd = jnp.zeros((1, 200, 2, 64))                         # padded in-op
+    assert fa.flash_attention_available(odd, odd, odd, None)
+    # dense [B,H,S,S] additive masks still decline to the XLA path
+    assert not fa.flash_attention_available(
+        q, q, q, jnp.ones((1, 2, 512, 512)))
+    # GQA (fewer kv heads) declines
+    kv = jnp.zeros((1, 512, 1, 64))
+    assert not fa.flash_attention_available(q, kv, kv, None)
+    # unsupported head_dim declines
+    bad_d = jnp.zeros((1, 512, 2, 32))
+    assert not fa.flash_attention_available(bad_d, bad_d, bad_d, None)
     fa.set_interpret(False)
     # off-TPU with interpret off -> unavailable
     assert not fa.flash_attention_available(q, q, q, None)
@@ -139,3 +149,223 @@ def test_gpt_layer_uses_flash_under_interpret():
     for a, b in zip(flat_f, flat_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-3)
+
+
+# ---- round-4 widened gate: masks, cross-attention, odd seqs, decode --------
+
+def _naive_full(q, k, v, causal, mask=None):
+    """Independent reference: [B,S,H,D], causal aligned-ends, key-padding
+    or dense additive/bool mask broadcastable to [B,H,S_q,S_k]."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    sc = jnp.einsum('bhqd,bhkd->bhqk', qt, kt) / np.sqrt(d)
+    if causal:
+        cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        sc = jnp.where(cm, sc, -1e30)
+    if mask is not None:
+        m = jnp.asarray(mask)
+        while m.ndim < 4:
+            m = m[:, None]
+        if m.dtype == jnp.bool_:
+            sc = jnp.where(m, sc, -1e30)
+        else:
+            sc = sc + m.astype(jnp.float32)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', p, vt)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+@pytest.mark.parametrize('mask_kind', ['bool2d', 'bool4d', 'additive'])
+def test_key_padding_mask_forward(mask_kind):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(10), 2, 512, 2, 64)
+    valid = np.ones((2, 512), bool)
+    valid[0, 300:] = False            # batch row 0 padded beyond 300
+    valid[1, 450:] = False
+    if mask_kind == 'bool2d':
+        mask = jnp.asarray(valid)
+    elif mask_kind == 'bool4d':
+        mask = jnp.asarray(valid)[:, None, None, :]
+    else:
+        mask = jnp.where(jnp.asarray(valid), 0.0, -1e30)[:, None, :]
+    got = fa.flash_attention(q, k, v, causal=False, mask=mask)
+    want = _naive_full(q, k, v, False, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_key_padding_mask_grad():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), 2, 256, 2, 64)
+    mask = jnp.asarray(np.arange(256)[None, :] < np.array([[200], [256]]))
+    tgt = jax.random.normal(jax.random.PRNGKey(12), q.shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum((fa.flash_attention(q, k, v, causal=True,
+                                           mask=mask) - tgt) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum((_naive_full(q, k, v, True, mask) - tgt) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_cross_attention(causal):
+    """s_q != s_k; causal uses the aligned-ends convention."""
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(13), 1, 256, 2, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(14), 1, 512, 2, 64)
+    got = fa.flash_attention(q, k, v, causal=causal)
+    want = _naive_full(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_grad():
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(15), 1, 256, 2, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(16), 1, 512, 2, 64)
+    tgt = jax.random.normal(jax.random.PRNGKey(17), q.shape)
+
+    def lf(q, k, v):
+        return jnp.sum((fa.flash_attention(q, k, v, causal=True) - tgt) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum((_naive_full(q, k, v, True) - tgt) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize('s', [200, 320])
+@pytest.mark.parametrize('causal', [False, True])
+def test_non_block_multiple_seq(s, causal):
+    """Sequences that don't tile to the 256 block: padded+masked in-op."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(18), 2, s, 2, 64)
+    got = fa.flash_attention(q, k, v, causal=causal)
+    want = _naive_full(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_non_block_multiple_seq_grad():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(19), 1, 320, 2, 64)
+    tgt = jax.random.normal(jax.random.PRNGKey(20), q.shape)
+
+    def lf(q, k, v):
+        return jnp.sum((fa.flash_attention(q, k, v, causal=True) - tgt) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum((_naive_full(q, k, v, True) - tgt) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_decode_parity():
+    """Decode kernel vs naive cached attention, traced position, under jit."""
+    B, S, H, D = 2, 256, 2, 64
+    key = jax.random.PRNGKey(21)
+    kc = jax.random.normal(key, (B, S, H, D))
+    vc = jax.random.normal(jax.random.PRNGKey(22), (B, S, H, D))
+    q = jax.random.normal(jax.random.PRNGKey(23), (B, 1, H, D))
+    assert fa.flash_decode_available(q, kc)
+
+    @jax.jit
+    def run(pos):
+        return fa.flash_decode(q, kc, vc, pos)
+
+    for pos in [0, 5, 100, 255]:
+        got = run(jnp.int32(pos))
+        # reference: q row 0 at absolute position pos attends keys <= pos
+        sc = jnp.einsum('bqhd,bkhd->bhqk', q, kc) / np.sqrt(D)
+        sc = jnp.where(jnp.arange(S)[None, None, None, :] <= pos, sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        want = jnp.einsum('bhqk,bkhd->bqhd', p, vc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_multi_row():
+    """T>1 rows (chunked prefill): row i attends keys <= pos+i."""
+    B, S, H, D, T = 1, 256, 2, 64, 4
+    kc = jax.random.normal(jax.random.PRNGKey(24), (B, S, H, D))
+    vc = jax.random.normal(jax.random.PRNGKey(25), (B, S, H, D))
+    q = jax.random.normal(jax.random.PRNGKey(26), (B, T, H, D))
+    pos = 10
+    got = fa.flash_decode(q, kc, vc, jnp.int32(pos))
+    sc = jnp.einsum('bqhd,bkhd->bhqk', q, kc) / np.sqrt(D)
+    valid = (jnp.arange(S)[None, :] <= pos + jnp.arange(T)[:, None])
+    sc = jnp.where(valid[None, None], sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    want = jnp.einsum('bhqk,bkhd->bqhd', p, vc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gpt_decode_routes_through_flash_kernels():
+    """With interpret on, gpt's KV-cache decode (prefill + per-token steps)
+    runs the pallas kernels and matches the einsum path numerically."""
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=128, num_layers=2,
+                        num_heads=2, max_seq_len=256, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 128)
+    tok = jnp.full((1,), 7, jnp.int32)
+
+    def drive():
+        prefill, step = gpt.make_decode_fns(cfg)
+        cache = gpt.init_kv_cache(cfg, 1)
+        logits0, cache = prefill(params, prompt, cache)
+        logits1, cache = step(params, tok, jnp.int32(8), cache)
+        logits2, _ = step(params, tok, jnp.int32(9), cache)
+        return logits0, logits1, logits2
+
+    flash_out = drive()                 # interpret on: kernels active
+    fa.set_interpret(False)
+    ref_out = drive()                   # einsum fallback path
+    for a, b in zip(flash_out, ref_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_per_head_mask_declines_and_sdpa_fallback_matches():
+    """[B,H,S_k] per-head masks must NOT be squeezed into per-batch rows
+    (review r4: with H==B the gate wrongly accepted them); and the XLA
+    fallback must accept the same [B,S_k] key-padding masks the kernel does."""
+    q = jnp.zeros((2, 256, 2, 64))
+    per_head = jnp.ones((2, 2, 256), bool)
+    assert not fa.flash_attention_available(q, q, q, per_head)
+
+    # same call works via the transparent fallback inside flash_attention
+    qq, kk, vv = _rand_qkv(jax.random.PRNGKey(30), 2, 256, 2, 64)
+    m = np.ones((2, 2, 256), bool)
+    m[0, 1, 100:] = False                  # head-specific padding
+    got = fa.flash_attention(qq, kk, vv, mask=jnp.asarray(m))
+    want = _naive_full(qq, kk, vv, False, jnp.asarray(m)[:, :, None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    # F.scaled_dot_product_attention with a [B,S_k] mask: flash path and
+    # XLA fallback agree (review r4: the fallback used to crash on it)
+    import paddle_tpu.nn.functional as F
+    pad = jnp.asarray(np.arange(256)[None, :] < np.array([[200], [256]]))
+    with_flash = F.scaled_dot_product_attention(qq, kk, vv, attn_mask=pad)
+    fa.set_interpret(False)                # kernel declines -> _sdpa_xla
+    without = F.scaled_dot_product_attention(qq, kk, vv, attn_mask=pad)
+    np.testing.assert_allclose(
+        np.asarray(with_flash._value if hasattr(with_flash, '_value')
+                   else with_flash),
+        np.asarray(without._value if hasattr(without, '_value')
+                   else without), atol=2e-5, rtol=2e-5)
