@@ -12,6 +12,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
+from .auto_parallel_planner import (  # noqa: F401
+    ShardingPlan, complete_shardings)
 
 
 class ProcessMesh:

@@ -1,0 +1,552 @@
+"""Auto-parallel sharding planner: PartitionSpec completion over a traced
+program.
+
+Reference: python/paddle/distributed/auto_parallel/completion.py:1 (propagate
+dims_mappings through the serial ProgramDesc), partitioner.py:1 (split the
+program), reshard.py (insert communication at mismatches). TPU-native
+redesign: the "serial program" is a jaxpr, dims_mappings are PartitionSpecs,
+and partitioning + collective insertion are GSPMD's job — so the planner's
+whole role is the COMPLETION pass: given a few seed annotations (inputs
+and/or key weights), infer PartitionSpecs for every other input by walking
+the jaxpr forward and backward to a fixpoint, and report the conflict points
+where GSPMD will have to reshard.
+
+    plan = complete_shardings(fn, example_args, seeds=seed_tree)
+    plan.arg_specs        # pytree of PartitionSpec matching example_args
+    plan.conflicts        # where specs disagreed (reshard points)
+    step = plan.apply(fn, mesh)           # jit with planned in_shardings
+    args = plan.place(example_args, mesh) # device_put by planned specs
+
+Propagation rules cover the primitive vocabulary of the model zoo (matmul
+family, elementwise, reductions, reshape/transpose/broadcast, gather,
+slicing, scan/pjit/remat recursion). Unknown primitives simply stop
+propagation along that edge — completion stays sound, just less complete.
+"""
+import jax
+from jax.extend.core import Literal
+from jax.sharding import NamedSharding, PartitionSpec
+
+_ELEMENTWISE = {
+    'add', 'sub', 'mul', 'div', 'max', 'min', 'pow', 'rem', 'atan2',
+    'and', 'or', 'xor', 'not', 'neg', 'sign', 'floor', 'ceil', 'round',
+    'exp', 'log', 'log1p', 'expm1', 'tanh', 'sin', 'cos', 'logistic',
+    'rsqrt', 'sqrt', 'cbrt', 'erf', 'erfc', 'erf_inv', 'abs',
+    'integer_pow', 'is_finite', 'select_n', 'nextafter', 'clamp',
+    'eq', 'ne', 'lt', 'le', 'gt', 'ge', 'convert_element_type',
+    'stop_gradient', 'copy', 'real', 'imag', 'square',
+}
+_REDUCE = {'reduce_sum', 'reduce_max', 'reduce_min', 'reduce_prod',
+           'reduce_and', 'reduce_or', 'argmax', 'argmin'}
+
+
+def _aval_ndim(atom):
+    return len(atom.aval.shape)
+
+
+def _aval_shape(atom):
+    return tuple(int(d) for d in atom.aval.shape)
+
+
+class _Env:
+    """var -> dim-spec tuple (axis-name | None per dim). Tracks change."""
+
+    def __init__(self, conflicts):
+        self.specs = {}
+        self.changed = False
+        self.conflicts = conflicts
+
+    def get(self, atom):
+        if isinstance(atom, Literal):
+            return (None,) * _aval_ndim(atom)
+        return self.specs.get(atom)
+
+    def known(self, atom):
+        return (isinstance(atom, Literal)
+                or atom in self.specs)
+
+    def update(self, var, spec, where=''):
+        if var is None or isinstance(var, Literal):
+            return
+        spec = tuple(spec)
+        if len(spec) != _aval_ndim(var):
+            return
+        old = self.specs.get(var)
+        if old is None:
+            self.specs[var] = self._dedup(spec, where)
+            self.changed = True
+            return
+        merged = []
+        for a, b in zip(old, spec):
+            if a is None:
+                merged.append(b)
+            elif b is None or a == b:
+                merged.append(a)
+            else:
+                self.conflicts.append(
+                    f'{where}: dim wants both {a!r} and {b!r} — keeping '
+                    f'{a!r} (GSPMD reshards here)')
+                merged.append(a)
+        merged = self._dedup(tuple(merged), where)
+        if merged != old:
+            self.specs[var] = merged
+            self.changed = True
+
+    def _dedup(self, spec, where):
+        """A mesh axis may shard at most one dim; keep the first."""
+        seen, out = set(), []
+        for a in spec:
+            if a is not None and a in seen:
+                self.conflicts.append(
+                    f'{where}: axis {a!r} appears on multiple dims — '
+                    'dropping the later one')
+                out.append(None)
+            else:
+                out.append(a)
+                if a is not None:
+                    seen.add(a)
+        return tuple(out)
+
+
+def _reshape_segments(in_shape, out_shape):
+    """Greedy factor-segment mapping between shapes; yields
+    (in_dims, out_dims) segment pairs with equal products."""
+    segs, i, j = [], 0, 0
+    while i < len(in_shape) or j < len(out_shape):
+        ii, jj = i, j
+        pi = in_shape[i] if i < len(in_shape) else 1
+        pj = out_shape[j] if j < len(out_shape) else 1
+        i, j = i + (i < len(in_shape)), j + (j < len(out_shape))
+        while pi != pj:
+            if pi < pj and i < len(in_shape):
+                pi *= in_shape[i]; i += 1
+            elif pj < pi and j < len(out_shape):
+                pj *= out_shape[j]; j += 1
+            else:
+                return segs                    # bail: unmappable tail
+        segs.append((list(range(ii, i)), list(range(jj, j))))
+    return segs
+
+
+def _map_reshape(spec, in_shape, out_shape, strict_first=True):
+    """Push a dim-spec through a reshape. A sharded dim survives iff it maps
+    1:1, or it is the LEADING dim of a split segment whose leading out dim
+    keeps its size-divisibility (the [B,S,H*D] -> [B,S,H,D] case)."""
+    out = [None] * len(out_shape)
+    for in_dims, out_dims in _reshape_segments(in_shape, out_shape):
+        if len(in_dims) == 1 and len(out_dims) == 1:
+            out[out_dims[0]] = spec[in_dims[0]]
+        elif len(in_dims) == 1:
+            out[out_dims[0]] = spec[in_dims[0]]        # split: to leading
+        elif len(out_dims) == 1:
+            # merge: leading in-dim's sharding survives on the merged dim
+            named = [spec[d] for d in in_dims if spec[d] is not None]
+            if spec[in_dims[0]] is not None:
+                out[out_dims[0]] = spec[in_dims[0]]
+            elif named and not strict_first:
+                out[out_dims[0]] = named[0]
+        # many-to-many: drop
+    return tuple(out)
+
+
+def _dot_dims(eqn):
+    (lc, rc), (lb, rb) = eqn.params['dimension_numbers']
+    lhs, rhs = eqn.invars
+    l_free = [d for d in range(_aval_ndim(lhs)) if d not in lc and d not in lb]
+    r_free = [d for d in range(_aval_ndim(rhs)) if d not in rc and d not in rb]
+    return lc, rc, lb, rb, l_free, r_free
+
+
+def _gather_maps(eqn):
+    dn = eqn.params['dimension_numbers']
+    operand, idx = eqn.invars
+    slice_sizes = eqn.params['slice_sizes']
+    out_ndim = _aval_ndim(eqn.outvars[0])
+    offset_dims = list(dn.offset_dims)
+    batch_out = [d for d in range(out_ndim) if d not in offset_dims]
+    op_offset = [d for d in range(_aval_ndim(operand))
+                 if d not in dn.collapsed_slice_dims
+                 and d not in getattr(dn, 'operand_batching_dims', ())]
+    # operand offset dim is positionally tied to an out offset dim; spec
+    # transfers only when the full dim is sliced
+    op_to_out = {}
+    for od, outd in zip(op_offset, offset_dims):
+        if slice_sizes[od] == _aval_shape(operand)[od]:
+            op_to_out[od] = outd
+    idx_batch = list(range(_aval_ndim(idx) - 1))       # drop index-vector dim
+    return op_to_out, idx_batch, batch_out
+
+
+def _inner_jaxpr(eqn):
+    for key in ('jaxpr', 'call_jaxpr', 'fun_jaxpr'):
+        j = eqn.params.get(key)
+        if j is not None:
+            return j
+    return None
+
+
+class _Planner:
+    def __init__(self, conflicts):
+        self.conflicts = conflicts
+
+    # ---- one equation, forward ----------------------------------------
+    def fwd(self, eqn, env):
+        name = eqn.primitive.name
+        where = name
+        if name in _ELEMENTWISE:
+            specs = [env.get(v) for v in eqn.invars
+                     if _aval_ndim(v) == _aval_ndim(eqn.outvars[0])]
+            for s in specs:
+                if s is not None:
+                    for o in eqn.outvars:
+                        env.update(o, s, where)
+        elif name in _REDUCE:
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                axes = set(eqn.params['axes'])
+                env.update(eqn.outvars[0],
+                           [a for d, a in enumerate(s) if d not in axes],
+                           where)
+        elif name == 'transpose':
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                perm = eqn.params['permutation']
+                env.update(eqn.outvars[0], [s[p] for p in perm], where)
+        elif name == 'broadcast_in_dim':
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                out = [None] * _aval_ndim(eqn.outvars[0])
+                oshape = _aval_shape(eqn.outvars[0])
+                ishape = _aval_shape(eqn.invars[0])
+                for i, od in enumerate(eqn.params['broadcast_dimensions']):
+                    if ishape[i] == oshape[od]:
+                        out[od] = s[i]
+                env.update(eqn.outvars[0], out, where)
+        elif name == 'reshape':
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                env.update(eqn.outvars[0],
+                           _map_reshape(s, _aval_shape(eqn.invars[0]),
+                                        _aval_shape(eqn.outvars[0])), where)
+        elif name == 'squeeze':
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                dims = set(eqn.params['dimensions'])
+                env.update(eqn.outvars[0],
+                           [a for d, a in enumerate(s) if d not in dims],
+                           where)
+        elif name == 'dot_general':
+            lhs, rhs = eqn.invars
+            ls, rs = env.get(lhs), env.get(rhs)
+            if ls is None and rs is None:
+                return
+            ls = ls or (None,) * _aval_ndim(lhs)
+            rs = rs or (None,) * _aval_ndim(rhs)
+            lc, rc, lb, rb, l_free, r_free = _dot_dims(eqn)
+            out = ([ls[d] or rs[rb[i]] for i, d in enumerate(lb)]
+                   + [ls[d] for d in l_free] + [rs[d] for d in r_free])
+            for cl, cr in zip(lc, rc):
+                if ls[cl] is not None and rs[cr] is not None \
+                        and ls[cl] != rs[cr]:
+                    self.conflicts.append(
+                        f'dot_general: contracting dim sharded {ls[cl]!r} '
+                        f'vs {rs[cr]!r} — GSPMD reshards one side')
+            env.update(eqn.outvars[0], out, where)
+        elif name == 'gather':
+            op_to_out, idx_batch, batch_out = _gather_maps(eqn)
+            os, isx = env.get(eqn.invars[0]), env.get(eqn.invars[1])
+            out = [None] * _aval_ndim(eqn.outvars[0])
+            if os is not None:
+                for od, outd in op_to_out.items():
+                    out[outd] = os[od]
+            if isx is not None:
+                for i, outd in zip(idx_batch, batch_out):
+                    out[outd] = isx[i]
+            if os is not None or isx is not None:
+                env.update(eqn.outvars[0], out, where)
+        elif name in ('slice', 'dynamic_slice', 'rev', 'pad',
+                      'dynamic_update_slice'):
+            src = eqn.invars[0]
+            s = env.get(src)
+            if s is not None:
+                in_shape, out_shape = _aval_shape(src), _aval_shape(
+                    eqn.outvars[0])
+                env.update(eqn.outvars[0],
+                           [a if in_shape[d] == out_shape[d] else None
+                            for d, a in enumerate(s)], where)
+        elif name == 'concatenate':
+            dim = eqn.params['dimension']
+            for v in eqn.invars:
+                s = env.get(v)
+                if s is not None:
+                    env.update(eqn.outvars[0],
+                               [None if d == dim else a
+                                for d, a in enumerate(s)], where)
+        elif name == 'scan':
+            self._scan(eqn, env)
+        elif _inner_jaxpr(eqn) is not None:
+            self._call(eqn, env)
+
+    # ---- one equation, backward (outputs known -> infer inputs) --------
+    def bwd(self, eqn, env):
+        name = eqn.primitive.name
+        where = name + '<-'
+        if name in _ELEMENTWISE:
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                for v in eqn.invars:
+                    if _aval_ndim(v) == len(s):
+                        env.update(v, s, where)
+        elif name == 'transpose':
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                perm = eqn.params['permutation']
+                inv = [None] * len(perm)
+                for i, p in enumerate(perm):
+                    inv[p] = s[i]
+                env.update(eqn.invars[0], inv, where)
+        elif name == 'broadcast_in_dim':
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                oshape = _aval_shape(eqn.outvars[0])
+                ishape = _aval_shape(eqn.invars[0])
+                spec = [s[od] if ishape[i] == oshape[od] else None
+                        for i, od in
+                        enumerate(eqn.params['broadcast_dimensions'])]
+                env.update(eqn.invars[0], spec, where)
+        elif name == 'reshape':
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                env.update(eqn.invars[0],
+                           _map_reshape(s, _aval_shape(eqn.outvars[0]),
+                                        _aval_shape(eqn.invars[0])), where)
+        elif name == 'dot_general':
+            lhs, rhs = eqn.invars
+            s = env.get(eqn.outvars[0])
+            ls, rs = env.get(lhs), env.get(rhs)
+            lc, rc, lb, rb, l_free, r_free = _dot_dims(eqn)
+            nb = len(lb)
+            if s is not None:
+                l_spec = [None] * _aval_ndim(lhs)
+                r_spec = [None] * _aval_ndim(rhs)
+                for i, d in enumerate(lb):
+                    l_spec[d] = s[i]
+                for i, d in enumerate(rb):
+                    r_spec[d] = s[i]
+                for i, d in enumerate(l_free):
+                    l_spec[d] = s[nb + i]
+                for i, d in enumerate(r_free):
+                    r_spec[d] = s[nb + len(l_free) + i]
+                env.update(lhs, l_spec, where)
+                env.update(rhs, r_spec, where)
+            # contracting-dim transfer: Megatron row-shard inference (an
+            # activation contracted over a sharded dim implies the weight's
+            # contracting dim carries the same axis)
+            if ls is not None:
+                r_spec = [None] * _aval_ndim(rhs)
+                for cl, cr in zip(lc, rc):
+                    r_spec[cr] = ls[cl]
+                if any(r_spec):
+                    env.update(rhs, r_spec, where + 'contract')
+            if rs is not None:
+                l_spec = [None] * _aval_ndim(lhs)
+                for cl, cr in zip(lc, rc):
+                    l_spec[cl] = rs[cr]
+                if any(l_spec):
+                    env.update(lhs, l_spec, where + 'contract')
+        elif name == 'gather':
+            op_to_out, idx_batch, batch_out = _gather_maps(eqn)
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                op_spec = [None] * _aval_ndim(eqn.invars[0])
+                for od, outd in op_to_out.items():
+                    op_spec[od] = s[outd]
+                env.update(eqn.invars[0], op_spec, where)
+                idx_spec = [None] * _aval_ndim(eqn.invars[1])
+                for i, outd in zip(idx_batch, batch_out):
+                    idx_spec[i] = s[outd]
+                env.update(eqn.invars[1], idx_spec, where)
+        elif name in _REDUCE:
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                axes = sorted(eqn.params['axes'])
+                spec = list(s)
+                for a in axes:
+                    spec.insert(a, None)
+                env.update(eqn.invars[0], spec, where)
+        elif name in ('slice', 'dynamic_slice', 'dynamic_update_slice',
+                      'rev', 'pad'):
+            src = eqn.invars[0]
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                in_shape = _aval_shape(src)
+                out_shape = _aval_shape(eqn.outvars[0])
+                env.update(src,
+                           [a if in_shape[d] == out_shape[d] else None
+                            for d, a in enumerate(s)], where)
+        elif name == 'scan':
+            self._scan(eqn, env)
+        elif _inner_jaxpr(eqn) is not None:
+            self._call(eqn, env)
+
+    # ---- recursion ------------------------------------------------------
+    def _body_pass(self, jaxpr, env):
+        for eqn in jaxpr.eqns:
+            self.fwd(eqn, env)
+        for eqn in reversed(jaxpr.eqns):
+            self.bwd(eqn, env)
+
+    def _call(self, eqn, env):
+        """pjit / remat / custom_vjp-style call: 1:1 invar mapping."""
+        inner = _inner_jaxpr(eqn)
+        jaxpr = inner.jaxpr if hasattr(inner, 'jaxpr') else inner
+        n = len(jaxpr.invars)
+        outer_in = eqn.invars[-n:] if len(eqn.invars) >= n else eqn.invars
+        sub = _Env(self.conflicts)
+        for bi, oi in zip(jaxpr.invars, outer_in):
+            s = env.get(oi)
+            if s is not None:
+                sub.update(bi, s, 'call-in')
+        for bo, oo in zip(jaxpr.outvars, eqn.outvars):
+            s = env.get(oo)
+            if s is not None and not isinstance(
+                    bo, Literal):
+                sub.update(bo, s, 'call-out')
+        self._body_pass(jaxpr, sub)
+        for bi, oi in zip(jaxpr.invars, outer_in):
+            s = sub.get(bi)
+            if s is not None:
+                env.update(oi, s, 'call-in<-')
+        for bo, oo in zip(jaxpr.outvars, eqn.outvars):
+            s = sub.get(bo)
+            if s is not None:
+                env.update(oo, s, 'call-out->')
+
+    def _scan(self, eqn, env):
+        inner = eqn.params['jaxpr']
+        jaxpr = inner.jaxpr if hasattr(inner, 'jaxpr') else inner
+        nc = eqn.params['num_consts']
+        ncar = eqn.params['num_carry']
+        consts, carry, xs = (eqn.invars[:nc], eqn.invars[nc:nc + ncar],
+                             eqn.invars[nc + ncar:])
+        car_out, ys = eqn.outvars[:ncar], eqn.outvars[ncar:]
+        b_consts = jaxpr.invars[:nc]
+        b_carry = jaxpr.invars[nc:nc + ncar]
+        b_xs = jaxpr.invars[nc + ncar:]
+        b_car_out = jaxpr.outvars[:ncar]
+        b_ys = jaxpr.outvars[ncar:]
+
+        sub = _Env(self.conflicts)
+        for bv, ov in zip(b_consts, consts):
+            s = env.get(ov)
+            if s is not None:
+                sub.update(bv, s, 'scan-const')
+        for bv, ov, oo in zip(b_carry, carry, car_out):
+            for s in (env.get(ov), env.get(oo)):
+                if s is not None:
+                    sub.update(bv, s, 'scan-carry')
+        for bv, ov in zip(b_xs, xs):
+            s = env.get(ov)
+            if s is not None:
+                sub.update(bv, s[1:], 'scan-xs')        # drop layer dim
+        for bv, ov in zip(b_ys, ys):
+            s = env.get(ov)
+            if s is not None and not isinstance(
+                    bv, Literal):
+                sub.update(bv, s[1:], 'scan-ys')
+
+        for _ in range(3):                               # carry fixpoint
+            sub.changed = False
+            self._body_pass(jaxpr, sub)
+            for bi, bo in zip(b_carry, b_car_out):
+                s = sub.get(bo)
+                if s is not None and not isinstance(
+                        bo, Literal):
+                    sub.update(bi, s, 'scan-carry-loop')
+            if not sub.changed:
+                break
+
+        # uniform-stacking rule: every xs shares one leading (layer) spec
+        leads = {env.get(v)[0] for v in xs
+                 if env.get(v) is not None and env.get(v)[0] is not None}
+        lead = leads.pop() if len(leads) == 1 else None
+
+        for bv, ov in zip(b_xs, xs):
+            s = sub.get(bv)
+            if s is not None:
+                old = env.get(ov)
+                env.update(ov, ((old[0] if old else lead),) + s, 'scan-xs<-')
+        for bv, ov, oo in zip(b_carry, carry, car_out):
+            s = sub.get(bv)
+            if s is not None:
+                env.update(ov, s, 'scan-carry<-')
+                env.update(oo, s, 'scan-carry->')
+        for bv, ov in zip(b_ys, ys):
+            s = sub.get(bv)
+            if s is not None and not isinstance(
+                    bv, Literal):
+                env.update(ov, (None,) + s, 'scan-ys->')
+        for bv, ov in zip(b_consts, consts):
+            s = sub.get(bv)
+            if s is not None:
+                env.update(ov, s, 'scan-const<-')
+
+
+class ShardingPlan:
+    def __init__(self, arg_specs, out_specs, conflicts):
+        self.arg_specs = arg_specs
+        self.out_specs = out_specs
+        self.conflicts = conflicts
+
+    def placements(self, mesh):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.arg_specs)
+
+    def place(self, args, mesh):
+        return jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(x, sh), args,
+            self.placements(mesh))
+
+    def apply(self, fn, mesh):
+        flat_sh, _ = jax.tree_util.tree_flatten(self.placements(mesh))
+        return jax.jit(fn, in_shardings=jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.arg_specs), flat_sh))
+
+
+def complete_shardings(fn, example_args, seeds, n_iter=8):
+    """Run the completion pass.
+
+    fn: pure function over ``example_args`` (a tuple of pytrees).
+    seeds: pytree matching ``example_args`` with PartitionSpec leaves where
+        the user annotated a sharding and None elsewhere.
+    Returns a ShardingPlan with a PartitionSpec for EVERY arg leaf.
+    """
+    flat_args, treedef = jax.tree_util.tree_flatten(example_args)
+    flat_seeds = treedef.flatten_up_to(seeds)
+
+    def flat_fn(*leaves):
+        return fn(*jax.tree_util.tree_unflatten(treedef, leaves))
+
+    closed = jax.make_jaxpr(flat_fn)(*flat_args)
+    jaxpr = closed.jaxpr
+    conflicts = []
+    env = _Env(conflicts)
+    planner = _Planner(conflicts)
+    for var, seed in zip(jaxpr.invars, flat_seeds):
+        if seed is not None:
+            spec = tuple(seed) + (None,) * (_aval_ndim(var) - len(tuple(seed)))
+            env.update(var, spec, 'seed')
+
+    for _ in range(n_iter):
+        env.changed = False
+        planner._body_pass(jaxpr, env)
+        if not env.changed:
+            break
+
+    def to_pspec(var):
+        s = env.get(var) or (None,) * _aval_ndim(var)
+        return PartitionSpec(*s)
+
+    arg_specs = jax.tree_util.tree_unflatten(
+        treedef, [to_pspec(v) for v in jaxpr.invars])
+    out_specs = [to_pspec(v) for v in jaxpr.outvars]
+    return ShardingPlan(arg_specs, out_specs, conflicts)

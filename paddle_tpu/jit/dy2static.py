@@ -358,9 +358,11 @@ def _mods_of(*stmt_lists):
         if info.escapes or info.complex_store:
             return None
         names |= info.assigned
-    # generated names are internal EXCEPT the break/continue flags and the
-    # while-form loop index — those are genuine loop-carried state
-    keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx')
+    # generated names are internal EXCEPT the break/continue flags, the
+    # while-form loop index, and the return-lowering result carrier —
+    # those are genuine branch/loop-carried state
+    keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx',
+            f'{_GEN_PREFIX}rv')
     return sorted(n for n in names
                   if not n.startswith(_GEN_PREFIX) or n.startswith(keep))
 
@@ -471,6 +473,79 @@ def _assign(name, value_node):
 
 def _const(v):
     return ast.Constant(value=v)
+
+
+class _ReturnLowering:
+    """Early-``return`` support (reference: dygraph_to_static/
+    return_transformer.py:1). A ``return`` inside an if-structure is lowered
+    to single-exit form by pushing the statements AFTER the if into the
+    else-continuation, so both arms of every tensor-convertible ``if`` bind
+    one result carrier:
+
+        if cond: return a          if cond: _pt_rv = a
+        rest...             =>     else:    rest...; _pt_rv = b
+        return b                   return _pt_rv
+
+    This preserves exact Python semantics for non-tensor conditions (the
+    restructured code runs the same statements in the same order) and makes
+    tensor-conditioned early returns convertible to lax.cond. Continuations
+    are deep-copied into each arm, so k sequential return-ifs cost O(2^k)
+    code size — fine for the 1-3 early returns real code has. ``return``
+    inside a LOOP body still raises the documented Dy2StaticError (a loop
+    carrier of unknown shape cannot be synthesized)."""
+
+    RV = f'{_GEN_PREFIX}rv'
+
+    def __init__(self):
+        self.applied = False
+
+    def _has_return(self, stmts):
+        for s in stmts or []:
+            if isinstance(s, ast.Return):
+                return True
+            if isinstance(s, ast.If) and (self._has_return(s.body)
+                                          or self._has_return(s.orelse)):
+                return True
+        return False
+
+    def block(self, stmts, cont):
+        """Rewrite one statement list; ``cont`` is the continuation that
+        runs when control falls off the end (shared: deep-copied on use)."""
+        import copy
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                self.applied = True
+                out.append(_assign(self.RV,
+                                   s.value or ast.Constant(value=None)))
+                return out                     # rest of block unreachable
+            if isinstance(s, ast.If) and (self._has_return(s.body)
+                                          or self._has_return(s.orelse)):
+                self.applied = True
+                new_cont = stmts[i + 1:] + cont
+                s.body = self.block(s.body, new_cont)
+                s.orelse = self.block(s.orelse or [], new_cont)
+                # terminal if: every path ends by binding the carrier, and
+                # ONLY the carrier is live afterwards — the converter then
+                # need not require branch-local temps bound in both arms
+                s._pt_return_exit = True
+                out.append(s)
+                return out
+            out.append(s)
+        if cont:
+            return out + self.block(copy.deepcopy(cont), [])
+        out.append(_assign(self.RV, ast.Constant(value=None)))
+        return out
+
+    def run(self, fdef):
+        needs = any(isinstance(s, ast.If) and (self._has_return(s.body)
+                                               or self._has_return(s.orelse))
+                    for s in fdef.body)
+        if not needs:
+            return False                   # no return under an if: no-op
+        fdef.body = self.block(fdef.body, [])
+        fdef.body.append(ast.Return(value=_load(self.RV)))
+        return self.applied
 
 
 class _BreakContinueTransformer(ast.NodeTransformer):
@@ -651,6 +726,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         node.test = _rewrite_boolops(node.test)
         mods = _mods_of(node.body, node.orelse)
+        if mods and getattr(node, '_pt_return_exit', False):
+            # return-lowered terminal if: only the result carrier is live
+            # after it; branch-local temps stay local to the branch fns
+            mods = [_ReturnLowering.RV]
         if mods is None or not mods:
             # not convertible (or pure side-effect): keep Python `if`, but
             # make a traced condition fail with a clear message
@@ -803,6 +882,7 @@ def convert_control_flow(fn):
         return fn
     fdef.decorator_list = []           # avoid re-entering to_static on exec
     try:
+        _ReturnLowering().run(fdef)
         bc = _BreakContinueTransformer()
         bc.visit(fdef)
         # hoist flag/index defaults to the function top: enclosing converted

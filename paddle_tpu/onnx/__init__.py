@@ -1,0 +1,82 @@
+"""paddle.onnx: real ONNX export (round 4; SURVEY row 51).
+
+Reference: python/paddle/onnx/export.py:105 (delegates to the external
+paddle2onnx package, which walks the ProgramDesc). TPU-native: the traced
+jaxpr IS the program, so ``export`` walks it directly and emits a
+self-contained .onnx ModelProto — hand-encoded wire format, no ``onnx``
+package dependency — covering the model-zoo op subset (matmul/conv/pool/
+norm/activations/shape ops). See onnx/_export.py for the op table.
+
+``jit.save``'s StableHLO + ``.pdexec`` artifacts remain the native serving
+interchange (inference.create_predictor); ONNX is the cross-ecosystem exit.
+``reference_run`` executes an exported model with the bundled reference
+runtime so exports can be validated without onnxruntime.
+"""
+import numpy as np
+
+from ._export import Exporter, OnnxExportError  # noqa: F401
+from ._proto import parse_model  # noqa: F401
+from ._runtime import run_model as reference_run  # noqa: F401
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export ``layer`` to ``<path>.onnx`` (plus the native StableHLO/
+    .pdexec artifacts via jit.save, matching the reference's behaviour of
+    producing a deployable bundle)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import jit as jit_mod
+    from ..nn.layer_base import (buffer_arrays, functional_call,
+                                 param_arrays)
+    from ..static import InputSpec
+
+    base = path[:-len('.onnx')] if path.endswith('.onnx') else path
+    jit_mod.save(layer, base, input_spec=input_spec)
+
+    if input_spec is None:
+        raise ValueError('onnx.export requires input_spec (the reference '
+                         'requires it for the same reason: the graph is '
+                         'traced at export time)')
+    xs = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if d in (None, -1) else int(d) for d in spec.shape]
+            xs.append(jnp.zeros(shape, jnp.dtype(spec.dtype)))
+        else:
+            xs.append(jnp.asarray(spec))
+
+    was_training = getattr(layer, 'training', False)
+    layer.eval()
+    try:
+        params, buffers = param_arrays(layer), buffer_arrays(layer)
+
+        def fwd(params, buffers, *xs):
+            out, _ = functional_call(layer, params, buffers, *xs)
+            return out
+
+        closed = jax.make_jaxpr(fwd)(params, buffers, *xs)
+    finally:
+        if was_training:
+            layer.train()
+    jaxpr = closed.jaxpr
+
+    n_param = len(jax.tree_util.tree_leaves(params))
+    n_buf = len(jax.tree_util.tree_leaves(buffers))
+    weight_vars = jaxpr.invars[:n_param + n_buf]
+    input_vars = jaxpr.invars[n_param + n_buf:]
+
+    ex = Exporter(graph_name=type(layer).__name__)
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        ex.const_vals[cv] = np.asarray(c)
+    flat_w = (jax.tree_util.tree_leaves(params)
+              + jax.tree_util.tree_leaves(buffers))
+    for var, val in zip(weight_vars, flat_w):
+        ex.const_vals[var] = np.asarray(val)
+    model_bytes = ex.build(jaxpr, input_vars,
+                           [f'input_{i}' for i in range(len(input_vars))],
+                           opset=opset_version)
+    out_path = base + '.onnx'
+    with open(out_path, 'wb') as f:
+        f.write(model_bytes)
+    return out_path

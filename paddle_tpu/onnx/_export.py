@@ -1,0 +1,420 @@
+"""jaxpr -> ONNX graph conversion.
+
+Reference: python/paddle/onnx/export.py:105 (delegates to paddle2onnx, which
+walks the ProgramDesc op by op). TPU-native: the traced jaxpr IS the program,
+so the exporter walks its equations, const-folds anything computable at
+export time (iota, shape math, eval-mode batchnorm constants), and emits ONNX
+nodes for the data-path primitives of the model zoo: matmul family, conv,
+pooling, elementwise, reductions, shape ops, select/compare, cast, gather.
+
+Inner jit/custom_vjp/remat calls are inlined. Unsupported primitives raise
+OnnxExportError naming the op so the scope is explicit.
+"""
+import numpy as np
+
+import jax
+from jax.extend.core import Literal
+
+from . import _proto as P
+
+
+class OnnxExportError(NotImplementedError):
+    pass
+
+
+_ELEMENTWISE = {
+    'add': 'Add', 'sub': 'Sub', 'mul': 'Mul', 'div': 'Div', 'pow': 'Pow',
+    'max': 'Max', 'min': 'Min',
+    'exp': 'Exp', 'log': 'Log', 'tanh': 'Tanh', 'logistic': 'Sigmoid',
+    'neg': 'Neg', 'abs': 'Abs', 'sqrt': 'Sqrt', 'sign': 'Sign',
+    'floor': 'Floor', 'ceil': 'Ceil', 'erf': 'Erf',
+    'sin': 'Sin', 'cos': 'Cos', 'stop_gradient': 'Identity',
+    'copy': 'Identity', 'and': 'And', 'or': 'Or', 'not': 'Not',
+}
+_COMPARE = {'lt': 'Less', 'le': 'LessOrEqual', 'gt': 'Greater',
+            'ge': 'GreaterOrEqual', 'eq': 'Equal'}
+_REDUCE_ATTR = {'reduce_max': 'ReduceMax', 'reduce_min': 'ReduceMin',
+                'reduce_prod': 'ReduceProd'}
+
+
+def _shape(atom):
+    return tuple(int(d) for d in atom.aval.shape)
+
+
+class Exporter:
+    def __init__(self, graph_name='paddle_tpu_graph'):
+        self.graph_name = graph_name
+        self.nodes = []
+        self.initializers = {}          # name -> ndarray
+        self.const_vals = {}            # var -> ndarray (foldable)
+        self.names = {}                 # var -> str
+        self._uid = 0
+
+    # ---- naming / values ------------------------------------------------
+    def _fresh(self, hint='t'):
+        self._uid += 1
+        return f'{hint}_{self._uid}'
+
+    def name_of(self, atom):
+        if isinstance(atom, Literal):
+            return self.add_const(np.asarray(atom.val))
+        if atom in self.const_vals and atom not in self.names:
+            self.names[atom] = self.add_const(self.const_vals[atom])
+        if atom not in self.names:
+            self.names[atom] = self._fresh('v')
+        return self.names[atom]
+
+    def add_const(self, arr, hint='c'):
+        arr = np.asarray(arr)
+        name = self._fresh(hint)
+        self.initializers[name] = arr
+        return name
+
+    def emit(self, op, inputs, n_out=1, **attrs):
+        outs = [self._fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, inputs, outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+    def _is_const(self, atom):
+        return isinstance(atom, Literal) or atom in self.const_vals
+
+    def _const_of(self, atom, what='operand'):
+        if isinstance(atom, Literal):
+            return np.asarray(atom.val)
+        if atom in self.const_vals:
+            return np.asarray(self.const_vals[atom])
+        raise OnnxExportError(f'{what} must be export-time constant')
+
+    # ---- main walk ------------------------------------------------------
+    def run(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            # const folding: every input known -> evaluate now
+            if all(self._is_const(v) for v in eqn.invars) \
+                    and eqn.primitive.name not in ('jit', 'pjit', 'scan',
+                                                   'while', 'cond'):
+                try:
+                    vals = [self._const_of(v) for v in eqn.invars]
+                    outs = eqn.primitive.bind(
+                        *[jax.numpy.asarray(v) for v in vals], **eqn.params)
+                    outs = outs if eqn.primitive.multiple_results else [outs]
+                    for var, val in zip(eqn.outvars, outs):
+                        self.const_vals[var] = np.asarray(val)
+                    continue
+                except Exception:
+                    pass                         # fall through to node emit
+            self._eqn(eqn)
+
+    def _inline(self, eqn):
+        if eqn.primitive.name in ('scan', 'while', 'cond', 'fori_loop'):
+            # inlining a loop body would execute it ONCE — silently wrong.
+            # Structured control flow needs ONNX Loop/If emission (not
+            # implemented); refuse loudly.
+            raise OnnxExportError(
+                f'primitive {eqn.primitive.name!r} (structured control '
+                'flow) is not supported by the ONNX exporter — unroll the '
+                'loop in the model (e.g. GPTConfig(scan_layers=False)-style '
+                'stacking) or export via StableHLO/jit.save instead')
+        inner = None
+        for key in ('jaxpr', 'call_jaxpr', 'fun_jaxpr'):
+            inner = eqn.params.get(key)
+            if inner is not None:
+                break
+        if inner is None:
+            raise OnnxExportError(
+                f'primitive {eqn.primitive.name!r} not supported by the '
+                'ONNX exporter')
+        jaxpr = inner.jaxpr if hasattr(inner, 'jaxpr') else inner
+        consts = getattr(inner, 'consts', [])
+        n = len(jaxpr.invars)
+        outer_in = eqn.invars[-n:]
+        # jit caches one traced jaxpr per function, so a second call site
+        # shares the SAME body Var objects: scrub every binding the previous
+        # inline left behind (invars and eqn outvars) or this call would
+        # fold with the previous call's constants
+        def scrub(jx):
+            for v in jx.invars:
+                self.const_vals.pop(v, None)
+                self.names.pop(v, None)
+            for e in jx.eqns:
+                for ov in e.outvars:
+                    self.const_vals.pop(ov, None)
+                    self.names.pop(ov, None)
+        scrub(jaxpr)
+        for cv, c in zip(jaxpr.constvars, consts):
+            self.const_vals[cv] = np.asarray(c)
+        for bi, oi in zip(jaxpr.invars, outer_in):
+            if oi in self.const_vals:
+                self.const_vals[bi] = self.const_vals[oi]
+            elif isinstance(oi, Literal):
+                self.const_vals[bi] = np.asarray(oi.val)
+            else:
+                self.names[bi] = self.name_of(oi)
+        self.run(jaxpr)
+        for bo, oo in zip(jaxpr.outvars, eqn.outvars):
+            if bo in self.const_vals:
+                self.const_vals[oo] = self.const_vals[bo]
+            else:
+                self.names[oo] = self.name_of(bo)
+
+    # ---- one equation ---------------------------------------------------
+    def _eqn(self, eqn):
+        name = eqn.primitive.name
+        out = eqn.outvars[0]
+
+        if name in _ELEMENTWISE:
+            got = self.emit(_ELEMENTWISE[name],
+                            [self.name_of(v) for v in eqn.invars])
+            self.names[out] = got
+        elif name in _COMPARE:
+            self.names[out] = self.emit(
+                _COMPARE[name], [self.name_of(v) for v in eqn.invars])
+        elif name == 'ne':
+            eqv = self.emit('Equal', [self.name_of(v) for v in eqn.invars])
+            self.names[out] = self.emit('Not', [eqv])
+        elif name == 'rem':
+            # lax.rem truncates toward zero (sign of dividend): ONNX Mod
+            # needs fmod=1 for those semantics (fmod=0 follows the divisor)
+            self.names[out] = self.emit(
+                'Mod', [self.name_of(v) for v in eqn.invars], fmod=1)
+        elif name == 'rsqrt':
+            s = self.emit('Sqrt', [self.name_of(eqn.invars[0])])
+            self.names[out] = self.emit('Reciprocal', [s])
+        elif name == 'square':
+            x = self.name_of(eqn.invars[0])
+            self.names[out] = self.emit('Mul', [x, x])
+        elif name == 'integer_pow':
+            x = self.name_of(eqn.invars[0])
+            y = eqn.params['y']
+            if y == 2:
+                self.names[out] = self.emit('Mul', [x, x])
+            else:
+                c = self.add_const(
+                    np.asarray(y, eqn.invars[0].aval.dtype))
+                self.names[out] = self.emit('Pow', [x, c])
+        elif name == 'select_n':
+            pred, *cases = eqn.invars
+            if len(cases) != 2:
+                raise OnnxExportError('select_n with >2 cases')
+            self.names[out] = self.emit(
+                'Where', [self.name_of(pred), self.name_of(cases[1]),
+                          self.name_of(cases[0])])
+        elif name == 'convert_element_type':
+            to = P.DTYPES[np.dtype(eqn.params['new_dtype'])]
+            self.names[out] = self.emit(
+                'Cast', [self.name_of(eqn.invars[0])], to=to)
+        elif name == 'reshape':
+            shp = self.add_const(np.asarray(_shape(out), np.int64))
+            self.names[out] = self.emit(
+                'Reshape', [self.name_of(eqn.invars[0]), shp])
+        elif name == 'squeeze':
+            shp = self.add_const(np.asarray(_shape(out), np.int64))
+            self.names[out] = self.emit(
+                'Reshape', [self.name_of(eqn.invars[0]), shp])
+        elif name == 'transpose':
+            self.names[out] = self.emit(
+                'Transpose', [self.name_of(eqn.invars[0])],
+                perm=list(eqn.params['permutation']))
+        elif name == 'broadcast_in_dim':
+            x = self.name_of(eqn.invars[0])
+            bcd = eqn.params['broadcast_dimensions']
+            mid = [1] * len(_shape(out))
+            for i, od in enumerate(bcd):
+                mid[od] = _shape(eqn.invars[0])[i]
+            shp_mid = self.add_const(np.asarray(mid, np.int64))
+            x = self.emit('Reshape', [x, shp_mid])
+            shp = self.add_const(np.asarray(_shape(out), np.int64))
+            self.names[out] = self.emit('Expand', [x, shp])
+        elif name == 'concatenate':
+            self.names[out] = self.emit(
+                'Concat', [self.name_of(v) for v in eqn.invars],
+                axis=int(eqn.params['dimension']))
+        elif name == 'slice':
+            starts = list(eqn.params['start_indices'])
+            ends = list(eqn.params['limit_indices'])
+            steps = list(eqn.params['strides'] or
+                         [1] * len(starts))
+            ins = [self.name_of(eqn.invars[0]),
+                   self.add_const(np.asarray(starts, np.int64)),
+                   self.add_const(np.asarray(ends, np.int64)),
+                   self.add_const(np.asarray(range(len(starts)), np.int64)),
+                   self.add_const(np.asarray(steps, np.int64))]
+            self.names[out] = self.emit('Slice', ins)
+        elif name == 'pad':
+            lo_hi_int = eqn.params['padding_config']
+            if any(i != 0 for _, _, i in lo_hi_int):
+                raise OnnxExportError('interior (dilating) pad')
+            pads = ([lo for lo, _, _ in lo_hi_int]
+                    + [hi for _, hi, _ in lo_hi_int])
+            if any(p < 0 for p in pads):
+                raise OnnxExportError('negative pad (use slice)')
+            cval = self._const_of(eqn.invars[1], 'pad value')
+            ins = [self.name_of(eqn.invars[0]),
+                   self.add_const(np.asarray(pads, np.int64)),
+                   self.add_const(np.asarray(cval))]
+            self.names[out] = self.emit('Pad', ins, mode='constant')
+        elif name == 'reduce_sum':
+            axes = self.add_const(
+                np.asarray(eqn.params['axes'], np.int64))
+            self.names[out] = self.emit(
+                'ReduceSum', [self.name_of(eqn.invars[0]), axes],
+                keepdims=0)
+        elif name in _REDUCE_ATTR:
+            self.names[out] = self.emit(
+                _REDUCE_ATTR[name], [self.name_of(eqn.invars[0])],
+                axes=list(eqn.params['axes']), keepdims=0)
+        elif name == 'dot_general':
+            self._dot(eqn)
+        elif name == 'conv_general_dilated':
+            self._conv(eqn)
+        elif name in ('reduce_window_max', 'reduce_window_sum'):
+            self._pool(eqn)
+        elif name == 'gather':
+            self._gather(eqn)
+        elif name == 'iota':
+            # static shape: materialize (normally reached via const folding,
+            # kept for safety)
+            d = eqn.params['dimension']
+            shape = _shape(out)
+            arr = np.broadcast_to(
+                np.arange(shape[d]).reshape(
+                    [-1 if i == d else 1 for i in range(len(shape))]),
+                shape).astype(np.dtype(eqn.params['dtype']))
+            self.const_vals[out] = arr
+        else:
+            self._inline(eqn)
+
+    # ---- structured ops -------------------------------------------------
+    def _dot(self, eqn):
+        lhs, rhs = eqn.invars
+        (lc, rc), (lb, rb) = eqn.params['dimension_numbers']
+        lsh, rsh = _shape(lhs), _shape(rhs)
+        l_free = [d for d in range(len(lsh)) if d not in lc and d not in lb]
+        r_free = [d for d in range(len(rsh)) if d not in rc and d not in rb]
+        ln, rn = self.name_of(lhs), self.name_of(rhs)
+
+        l_perm = list(lb) + l_free + list(lc)
+        r_perm = list(rb) + list(rc) + r_free
+        if l_perm != list(range(len(lsh))):
+            ln = self.emit('Transpose', [ln], perm=l_perm)
+        if r_perm != list(range(len(rsh))):
+            rn = self.emit('Transpose', [rn], perm=r_perm)
+        k = int(np.prod([lsh[d] for d in lc], dtype=np.int64)) if lc else 1
+        m = int(np.prod([lsh[d] for d in l_free], dtype=np.int64))
+        n = int(np.prod([rsh[d] for d in r_free], dtype=np.int64))
+        batch = [lsh[d] for d in lb]
+        l2 = self.add_const(np.asarray(batch + [m, k], np.int64))
+        r2 = self.add_const(np.asarray(batch + [k, n], np.int64))
+        ln = self.emit('Reshape', [ln, l2])
+        rn = self.emit('Reshape', [rn, r2])
+        mm = self.emit('MatMul', [ln, rn])
+        fin = self.add_const(np.asarray(_shape(eqn.outvars[0]), np.int64))
+        self.names[eqn.outvars[0]] = self.emit('Reshape', [mm, fin])
+
+    def _conv(self, eqn):
+        lhs, rhs = eqn.invars
+        dn = eqn.params['dimension_numbers']
+        if any(d != 1 for d in eqn.params['lhs_dilation']):
+            raise OnnxExportError('transposed conv (lhs_dilation)')
+        x = self.name_of(lhs)
+        wgt = self.name_of(rhs)
+        lspec, rspec, ospec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+        if list(lspec) != list(range(len(lspec))):
+            x = self.emit('Transpose', [x], perm=list(lspec))
+        if list(rspec) != list(range(len(rspec))):
+            wgt = self.emit('Transpose', [wgt], perm=list(rspec))
+        pads = ([lo for lo, _ in eqn.params['padding']]
+                + [hi for _, hi in eqn.params['padding']])
+        conv = self.emit(
+            'Conv', [x, wgt],
+            strides=list(eqn.params['window_strides']),
+            pads=pads,
+            dilations=list(eqn.params['rhs_dilation']),
+            group=int(eqn.params['feature_group_count']))
+        inv = np.argsort(ospec).tolist()
+        if inv != list(range(len(ospec))):
+            conv = self.emit('Transpose', [conv], perm=inv)
+        self.names[eqn.outvars[0]] = conv
+
+    def _pool(self, eqn):
+        name = eqn.primitive.name
+        wd = list(eqn.params['window_dimensions'])
+        ws = list(eqn.params['window_strides'])
+        pad = list(eqn.params['padding'])
+        if any(d != 1 for d in eqn.params.get('base_dilation', [1] * len(wd))
+               ) or any(d != 1 for d in
+                        eqn.params.get('window_dilation', [1] * len(wd))):
+            raise OnnxExportError('dilated pooling window')
+        pass_dims = [d for d in range(len(wd))
+                     if wd[d] == 1 and ws[d] == 1 and pad[d] == (0, 0)]
+        win_dims = [d for d in range(len(wd)) if d not in pass_dims]
+        if len(pass_dims) != 2:
+            raise OnnxExportError(
+                f'pooling window over {len(win_dims)} dims with '
+                f'{len(pass_dims)} passthrough dims (need N,C + spatial)')
+        perm = pass_dims + win_dims
+        x = self.name_of(eqn.invars[0])
+        if perm != list(range(len(wd))):
+            x = self.emit('Transpose', [x], perm=perm)
+        kernel = [wd[d] for d in win_dims]
+        pads = ([pad[d][0] for d in win_dims]
+                + [pad[d][1] for d in win_dims])
+        if name == 'reduce_window_max':
+            pool = self.emit('MaxPool', [x], kernel_shape=kernel,
+                             strides=[ws[d] for d in win_dims], pads=pads)
+        else:
+            # sum pool = AveragePool(count_include_pad) * window_size
+            pool = self.emit('AveragePool', [x], kernel_shape=kernel,
+                             strides=[ws[d] for d in win_dims], pads=pads,
+                             count_include_pad=1)
+            k = self.add_const(
+                np.asarray(np.prod(kernel),
+                           np.dtype(eqn.invars[0].aval.dtype)))
+            pool = self.emit('Mul', [pool, k])
+        inv = np.argsort(perm).tolist()
+        if inv != list(range(len(wd))):
+            pool = self.emit('Transpose', [pool], perm=inv)
+        self.names[eqn.outvars[0]] = pool
+
+    def _gather(self, eqn):
+        operand, idx = eqn.invars
+        dn = eqn.params['dimension_numbers']
+        osh = _shape(operand)
+        slice_sizes = list(eqn.params['slice_sizes'])
+        # simple take(arr, idx, axis): ONE collapsed gathered dim, full
+        # slices elsewhere, trailing index-vector dim of size 1
+        if (len(dn.start_index_map) == 1
+                and dn.collapsed_slice_dims == (dn.start_index_map[0],)
+                and _shape(idx)[-1] == 1
+                and all(slice_sizes[d] == osh[d]
+                        for d in range(len(osh))
+                        if d != dn.start_index_map[0])):
+            axis = dn.start_index_map[0]
+            idx_name = self.name_of(idx)
+            ish = _shape(idx)[:-1]
+            shp = self.add_const(np.asarray(ish, np.int64))
+            idx_name = self.emit('Reshape', [idx_name, shp])
+            self.names[eqn.outvars[0]] = self.emit(
+                'Gather', [self.name_of(operand), idx_name], axis=axis)
+        else:
+            raise OnnxExportError('general gather (only take-style '
+                                  'single-axis gathers are exported)')
+
+    # ---- finish ---------------------------------------------------------
+    def build(self, jaxpr, input_vars, input_names, opset=13):
+        inputs = []
+        for var, iname in zip(input_vars, input_names):
+            self.names[var] = iname
+            inputs.append(P.value_info(iname, var.aval.dtype, _shape(var)))
+        self.run(jaxpr)
+        outputs = []
+        for i, ov in enumerate(jaxpr.outvars):
+            oname = self.name_of(ov)
+            if ov in self.const_vals and oname in self.initializers:
+                # constant output: route through Identity so it is a node
+                oname = self.emit('Identity', [oname])
+            outputs.append(P.value_info(f'output_{i}', ov.aval.dtype,
+                                        _shape(ov)))
+            self.nodes.append(P.node('Identity', [oname], [f'output_{i}']))
+        inits = [P.tensor(n, a) for n, a in self.initializers.items()]
+        g = P.graph(self.nodes, self.graph_name, inits, inputs, outputs)
+        return P.model(g, opset_version=opset)

@@ -1,0 +1,168 @@
+"""ONNX ModelProto construction/parsing over the wire-format helpers.
+
+Field numbers transcribed from the public onnx.proto schema (interface
+facts). Only the subset the exporter emits is covered. Tensors use raw_data
+(little-endian), the de-facto standard encoding.
+"""
+import numpy as np
+
+from . import _wire as w
+
+# TensorProto.DataType
+DTYPES = {
+    np.dtype('float32'): 1, np.dtype('uint8'): 2, np.dtype('int8'): 3,
+    np.dtype('uint16'): 4, np.dtype('int16'): 5, np.dtype('int32'): 6,
+    np.dtype('int64'): 7, np.dtype('bool'): 9, np.dtype('float16'): 10,
+    np.dtype('float64'): 11, np.dtype('uint32'): 12, np.dtype('uint64'): 13,
+}
+DTYPES_INV = {v: k for k, v in DTYPES.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS, A_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype == np.dtype('bool'):
+        raw = arr.astype(np.uint8).tobytes()
+    else:
+        raw = arr.tobytes()
+    out = b''.join(w.emit_varint(1, d) for d in arr.shape)
+    out += w.emit_varint(2, DTYPES[arr.dtype])
+    out += w.emit_bytes(8, name)
+    out += w.emit_bytes(9, raw)
+    return out
+
+
+def attr(name, value):
+    out = w.emit_bytes(1, name)
+    if isinstance(value, float):
+        out += w.emit_float(2, value) + w.emit_varint(20, A_FLOAT)
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += w.emit_varint(3, int(value)) + w.emit_varint(20, A_INT)
+    elif isinstance(value, str):
+        out += w.emit_bytes(4, value) + w.emit_varint(20, A_STRING)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for v in value:
+            out += w.emit_float(7, v)
+        out += w.emit_varint(20, A_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += w.emit_varint(8, int(v))
+        out += w.emit_varint(20, A_INTS)
+    else:
+        raise TypeError(f'attr {name}: unsupported {type(value)}')
+    return out
+
+
+def node(op_type, inputs, outputs, name='', **attrs):
+    out = b''.join(w.emit_bytes(1, i) for i in inputs)
+    out += b''.join(w.emit_bytes(2, o) for o in outputs)
+    if name:
+        out += w.emit_bytes(3, name)
+    out += w.emit_bytes(4, op_type)
+    for k, v in attrs.items():
+        out += w.emit_message(5, attr(k, v))
+    return out
+
+
+def value_info(name, dtype, shape):
+    dims = b''
+    for d in shape:
+        if isinstance(d, str) or d is None:
+            dims += w.emit_message(1, w.emit_bytes(2, str(d or 'N')))
+        else:
+            dims += w.emit_message(1, w.emit_varint(1, int(d)))
+    ttype = (w.emit_varint(1, DTYPES[np.dtype(dtype)])
+             + w.emit_message(2, dims))
+    return w.emit_bytes(1, name) + w.emit_message(2, w.emit_message(1, ttype))
+
+
+def graph(nodes, name, initializers, inputs, outputs):
+    out = b''.join(w.emit_message(1, n) for n in nodes)
+    out += w.emit_bytes(2, name)
+    out += b''.join(w.emit_message(5, t) for t in initializers)
+    out += b''.join(w.emit_message(11, i) for i in inputs)
+    out += b''.join(w.emit_message(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes, opset_version=13, producer='paddle_tpu'):
+    opset = w.emit_bytes(1, '') + w.emit_varint(2, opset_version)
+    return (w.emit_varint(1, 8)                       # ir_version 8
+            + w.emit_bytes(2, producer)
+            + w.emit_message(7, graph_bytes)
+            + w.emit_message(8, opset))
+
+
+# ---- parsing (for the reference runtime + round-trip tests) ---------------
+
+def _s(b):
+    return b.decode('utf-8')
+
+
+def parse_tensor(buf):
+    f = w.parse(buf)
+    dims = [w.to_signed(v) for v in f.get(1, [])]
+    dt = DTYPES_INV[f[2][0]]
+    name = _s(f[8][0]) if 8 in f else ''
+    if 9 in f:
+        raw = f[9][0]
+        arr = (np.frombuffer(raw, np.uint8).astype(bool)
+               if dt == np.dtype('bool')
+               else np.frombuffer(raw, dt))
+        arr = arr.reshape(dims)
+    else:
+        raise ValueError('tensor without raw_data')
+    return name, arr
+
+
+def parse_attr(buf):
+    import struct
+    f = w.parse(buf)
+    name = _s(f[1][0])
+    atype = f.get(20, [0])[0]
+    if atype == A_FLOAT:
+        return name, struct.unpack('<f', f[2][0])[0]
+    if atype == A_INT:
+        return name, w.to_signed(f[3][0])
+    if atype == A_STRING:
+        return name, _s(f[4][0])
+    if atype == A_INTS:
+        return name, [w.to_signed(v) for v in f.get(8, [])]
+    if atype == A_FLOATS:
+        return name, [struct.unpack('<f', v)[0] for v in f.get(7, [])]
+    if atype == A_TENSOR:
+        return name, parse_tensor(f[5][0])[1]
+    raise ValueError(f'attr {name}: unsupported type {atype}')
+
+
+def parse_node(buf):
+    f = w.parse(buf)
+    return {
+        'inputs': [_s(b) for b in f.get(1, [])],
+        'outputs': [_s(b) for b in f.get(2, [])],
+        'op_type': _s(f[4][0]),
+        'attrs': dict(parse_attr(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(buf):
+    f = w.parse(buf)
+    return _s(f[1][0])
+
+
+def parse_model(buf):
+    f = w.parse(buf)
+    g = w.parse(f[7][0])
+    return {
+        'ir_version': f.get(1, [0])[0],
+        'opset': [w.parse(o).get(2, [0])[0] for o in f.get(8, [])],
+        'name': _s(g[2][0]) if 2 in g else '',
+        'nodes': [parse_node(n) for n in g.get(1, [])],
+        'initializers': dict(parse_tensor(t) for t in g.get(5, [])),
+        'inputs': [parse_value_info(i) for i in g.get(11, [])],
+        'outputs': [parse_value_info(o) for o in g.get(12, [])],
+    }

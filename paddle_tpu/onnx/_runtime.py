@@ -1,0 +1,136 @@
+"""Reference ONNX executor for the exported op subset.
+
+Runs a parsed ModelProto with numpy/jax — independent of the exporter's
+jaxpr walk, so exporter↔runtime agreement is a real graph-semantics check
+(and users without onnxruntime can still smoke-test exported models)."""
+import numpy as np
+
+from . import _proto as P
+
+
+def _np_conv(x, w, attrs):
+    import jax
+    return np.asarray(jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=attrs.get('strides', [1] * (x.ndim - 2)),
+        padding=list(zip(attrs.get('pads', [0] * 2 * (x.ndim - 2))
+                         [:x.ndim - 2],
+                         attrs.get('pads', [0] * 2 * (x.ndim - 2))
+                         [x.ndim - 2:])),
+        rhs_dilation=attrs.get('dilations', [1] * (x.ndim - 2)),
+        feature_group_count=attrs.get('group', 1),
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW')[:3]
+        if x.ndim == 4 else None))
+
+
+def _pool(x, attrs, kind):
+    import jax
+    k = attrs['kernel_shape']
+    s = attrs.get('strides', [1] * len(k))
+    pads = attrs.get('pads', [0] * 2 * len(k))
+    pad = [(0, 0), (0, 0)] + list(zip(pads[:len(k)], pads[len(k):]))
+    wd = [1, 1] + list(k)
+    ws = [1, 1] + list(s)
+    if kind == 'max':
+        init, op = -np.inf, jax.lax.max
+    else:
+        init, op = 0.0, jax.lax.add
+    out = jax.lax.reduce_window(x, np.asarray(init, x.dtype), op, wd, ws,
+                                pad)
+    if kind == 'avg':
+        out = out / np.prod(k)
+    return np.asarray(out)
+
+
+def run_model(parsed_or_bytes, inputs):
+    """Execute the graph. inputs: dict name->array or positional list."""
+    m = (parsed_or_bytes if isinstance(parsed_or_bytes, dict)
+         else P.parse_model(parsed_or_bytes))
+    env = dict(m['initializers'])
+    if isinstance(inputs, (list, tuple)):
+        inputs = dict(zip(m['inputs'], inputs))
+    env.update({k: np.asarray(v) for k, v in inputs.items()})
+
+    for nd in m['nodes']:
+        op = nd['op_type']
+        a = nd['attrs']
+        x = [env[i] for i in nd['inputs']]
+        if op == 'Identity':
+            r = x[0]
+        elif op in ('Add', 'Sub', 'Mul', 'Div', 'Pow'):
+            f = {'Add': np.add, 'Sub': np.subtract, 'Mul': np.multiply,
+                 'Div': np.divide, 'Pow': np.power}[op]
+            r = f(x[0], x[1])
+        elif op in ('Max', 'Min'):
+            r = (np.maximum if op == 'Max' else np.minimum)(*x)
+        elif op == 'Mod':
+            r = (np.fmod if a.get('fmod') else np.mod)(x[0], x[1])
+        elif op == 'Relu':
+            r = np.maximum(x[0], 0)
+        elif op in ('Exp', 'Log', 'Tanh', 'Neg', 'Abs', 'Sqrt', 'Floor',
+                    'Ceil', 'Sign', 'Sin', 'Cos'):
+            r = getattr(np, op.lower())(x[0])
+        elif op == 'Sigmoid':
+            r = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == 'Erf':
+            from scipy.special import erf as _erf          # pragma: no cover
+            r = _erf(x[0])
+        elif op == 'Reciprocal':
+            r = 1.0 / x[0]
+        elif op in ('And', 'Or', 'Not'):
+            f = {'And': np.logical_and, 'Or': np.logical_or,
+                 'Not': np.logical_not}[op]
+            r = f(*x)
+        elif op in ('Less', 'LessOrEqual', 'Greater', 'GreaterOrEqual',
+                    'Equal'):
+            f = {'Less': np.less, 'LessOrEqual': np.less_equal,
+                 'Greater': np.greater, 'GreaterOrEqual': np.greater_equal,
+                 'Equal': np.equal}[op]
+            r = f(x[0], x[1])
+        elif op == 'Where':
+            r = np.where(x[0], x[1], x[2])
+        elif op == 'Cast':
+            r = x[0].astype(P.DTYPES_INV[a['to']])
+        elif op == 'Reshape':
+            r = x[0].reshape([int(d) for d in x[1]])
+        elif op == 'Expand':
+            r = np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+        elif op == 'Transpose':
+            r = np.transpose(x[0], a['perm'])
+        elif op == 'Concat':
+            r = np.concatenate(x, axis=a['axis'])
+        elif op == 'Slice':
+            data, starts, ends, axes, steps = x
+            sl = [slice(None)] * data.ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(st), int(en), int(sp))
+            r = data[tuple(sl)]
+        elif op == 'Pad':
+            data, pads, cval = x
+            n = data.ndim
+            width = [(int(pads[i]), int(pads[n + i])) for i in range(n)]
+            r = np.pad(data, width, constant_values=cval)
+        elif op == 'Gather':
+            r = np.take(x[0], x[1].astype(np.int64), axis=a.get('axis', 0))
+        elif op == 'MatMul':
+            r = np.matmul(x[0], x[1])
+        elif op == 'ReduceSum':
+            axes = tuple(int(d) for d in x[1]) if len(x) > 1 else None
+            r = np.sum(x[0], axis=axes,
+                       keepdims=bool(a.get('keepdims', 1)))
+        elif op in ('ReduceMax', 'ReduceMin', 'ReduceProd'):
+            f = {'ReduceMax': np.max, 'ReduceMin': np.min,
+                 'ReduceProd': np.prod}[op]
+            r = f(x[0], axis=tuple(a['axes']),
+                  keepdims=bool(a.get('keepdims', 1)))
+        elif op == 'Conv':
+            r = _np_conv(x[0], x[1], a)
+        elif op == 'MaxPool':
+            r = _pool(x[0], a, 'max')
+        elif op == 'AveragePool':
+            r = _pool(x[0], a, 'avg')
+        else:
+            raise NotImplementedError(f'reference runtime: op {op}')
+        env[nd['outputs'][0]] = np.asarray(r)
+
+    return [env[o] for o in m['outputs']]

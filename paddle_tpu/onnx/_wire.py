@@ -1,0 +1,102 @@
+"""Minimal protobuf wire-format writer/reader (original implementation from
+the public wire-format spec: varints, field tag = (number << 3) | wire_type,
+length-delimited submessages). Enough to emit and re-read ONNX ModelProto
+without the ``onnx`` or ``protobuf``-generated bindings.
+
+Messages are represented as plain dicts: {field_number: value-or-list}. The
+schema (which fields are submessages vs scalars) lives at the call site
+(_schema.py); the reader returns raw bytes for length-delimited fields and
+the caller decides whether to recurse.
+"""
+import struct
+
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1                    # two's-complement for negatives
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field, wire_type):
+    return _varint((field << 3) | wire_type)
+
+
+def emit_varint(field, value):
+    return tag(field, 0) + _varint(int(value))
+
+
+def emit_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode('utf-8')
+    return tag(field, 2) + _varint(len(data)) + bytes(data)
+
+
+def emit_message(field, encoded):
+    return emit_bytes(field, encoded)
+
+
+def emit_float(field, value):
+    return tag(field, 5) + struct.pack('<f', float(value))
+
+
+def emit_packed_varints(field, values):
+    payload = b''.join(_varint(int(v)) for v in values)
+    return emit_bytes(field, payload)
+
+
+# ---- reading ---------------------------------------------------------------
+
+def read_varint(buf, pos):
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf):
+    """-> {field_number: [raw values]} ; wire-type 0 values are ints,
+    wire-type 2 are bytes, wire-type 5 are 4-byte buffers."""
+    fields = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = read_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f'unsupported wire type {wt}')
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def unpack_varints(data):
+    out, pos = [], 0
+    while pos < len(data):
+        v, pos = read_varint(data, pos)
+        out.append(v)
+    return out
+
+
+def to_signed(v, bits=64):
+    return v - (1 << bits) if v >= (1 << (bits - 1)) else v

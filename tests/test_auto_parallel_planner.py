@@ -1,0 +1,114 @@
+"""Auto-parallel completion pass (VERDICT r3 'Next' #4): given a handful of
+seed annotations, the planner must complete PartitionSpecs for EVERY GPT
+parameter identically to the hand-written Megatron specs in
+models/gpt.py::param_specs, on the 8-device mesh.
+
+Reference: python/paddle/distributed/auto_parallel/completion.py:1,
+partitioner.py:1 (dims_mapping propagation over the serial program)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import complete_shardings
+from paddle_tpu.models import gpt
+
+
+def _none_tree(tree):
+    return jax.tree_util.tree_map(lambda _: None, tree,
+                                  is_leaf=lambda x: x is None)
+
+
+def _gpt_setup(mp=2, pp=1):
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=2, max_seq_len=16, dtype='float32',
+                        use_flash=False, remat=False, mp=mp, pp=pp)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.zeros((4, 16), jnp.int32)
+    return cfg, params, toks
+
+
+def test_gpt_completion_matches_manual_specs():
+    cfg, params, toks = _gpt_setup(mp=2)
+
+    def fwd(params, toks):
+        return gpt.forward(params, toks, cfg)
+
+    # seeds: the user annotates the embedding, ONE column-parallel weight in
+    # the (shared) scanned block per matmul pair, and the data batch — the
+    # planner must complete everything else (row-parallel proj/out weights
+    # via contracting-dim inference, col-sharded biases, replicated norms)
+    seeds = ({'wte': P('mp', None),
+              'wpe': None,
+              'lnf_g': None, 'lnf_b': None,
+              'blocks': {
+                  'ln1_g': None, 'ln1_b': None,
+                  'qkv_w': P(None, None, 'mp'), 'qkv_b': None,
+                  'proj_w': None, 'proj_b': None,
+                  'ln2_g': None, 'ln2_b': None,
+                  'fc_w': P(None, None, 'mp'), 'fc_b': None,
+                  'out_w': None, 'out_b': None}},
+             P('dp', None))
+
+    plan = complete_shardings(fwd, (params, toks), seeds)
+    got, _ = plan.arg_specs
+    want = gpt.param_specs(cfg)
+
+    flat_got = jax.tree_util.tree_flatten_with_path(got)[0]
+    want_flat = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_flatten_with_path(want)[0])
+    for key, spec in flat_got:
+        ks = jax.tree_util.keystr(key)
+        w = want_flat[ks]
+        # normalize: trailing Nones are insignificant in PartitionSpec
+        def norm(s):
+            t = tuple(s)
+            while t and t[-1] is None:
+                t = t[:-1]
+            return t
+        assert norm(spec) == norm(w), f'{ks}: planner {spec} != manual {w}'
+
+
+def test_completion_runs_on_mesh():
+    """The plan actually executes: place params by planned specs on the
+    8-device mesh and run the forward jitted with planned in_shardings."""
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 4, 'mp_degree': 2}
+    topo = fleet.init(is_collective=True, strategy=strategy)
+
+    cfg, params, toks = _gpt_setup(mp=2)
+
+    def fwd(params, toks):
+        return gpt.forward(params, toks, cfg)
+
+    seeds = (jax.tree_util.tree_map(lambda _: None, params), P('dp', None))
+    seeds[0]['wte'] = P('mp', None)
+    seeds[0]['blocks']['qkv_w'] = P(None, None, 'mp')
+    seeds[0]['blocks']['fc_w'] = P(None, None, 'mp')
+
+    plan = complete_shardings(fwd, (params, toks), seeds)
+    placed = plan.place((params, toks), topo.mesh)
+    out = plan.apply(fwd, topo.mesh)(*placed)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_conflict_reporting():
+    """Contradictory seeds surface as reshard reports, not silent failure."""
+    def f(a, b):
+        return a + b
+
+    x = jnp.zeros((8, 8))
+    plan = complete_shardings(f, (x, x), (P('dp', None), P(None, 'dp')))
+    assert plan.conflicts                      # the add must reshard one side
+
+
+def test_unknown_primitive_is_sound():
+    """An op with no rule stops propagation but never crashes the pass."""
+    def f(x):
+        return jnp.sort(x, axis=-1) * 2.0
+
+    plan = complete_shardings(f, (jnp.zeros((4, 8)),), (P('dp', None),))
+    assert isinstance(plan.arg_specs[0], P)

@@ -381,9 +381,8 @@ def test_converted_bf16_model_serves_without_config(tmp_path):
 
 
 def test_onnx_export_writes_portable_artifacts(tmp_path):
-    """paddle.onnx.export always produces the StableHLO interchange
-    artifacts; the .onnx protobuf itself is gated on the unavailable onnx
-    package with an actionable error."""
+    """paddle.onnx.export writes a REAL .onnx (r4) plus the StableHLO
+    interchange artifacts (full exporter coverage: test_onnx_export.py)."""
     import os
     import paddle_tpu.nn as nn
 
@@ -398,11 +397,10 @@ def test_onnx_export_writes_portable_artifacts(tmp_path):
     net = Net()
     net.eval()
     path = os.path.join(str(tmp_path), 'm.onnx')
-    with pytest.raises(RuntimeError) as ei:
-        paddle.onnx.export(net, path, input_spec=[
-            paddle.static.InputSpec([None, 4], 'float32')])
-    assert 'stablehlo' in str(ei.value).lower()
+    out = paddle.onnx.export(net, path, input_spec=[
+        paddle.static.InputSpec([None, 4], 'float32')])
     base = os.path.join(str(tmp_path), 'm')
+    assert out == base + '.onnx' and os.path.exists(out)
     assert os.path.exists(base + '.stablehlo')
     assert os.path.exists(base + '.pdexec')
 

@@ -1,0 +1,150 @@
+"""Real ONNX export (VERDICT r3 'Next' #7; SURVEY row 51).
+
+Reference: python/paddle/onnx/export.py:105. paddle.onnx.export writes a
+self-contained .onnx ModelProto (hand-encoded wire format — no onnx package
+in this image) and the bundled reference runtime executes it for numerical
+parity against the eager model."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import onnx as ponnx
+
+
+def _roundtrip(net, shape, atol=1e-5, seed=0):
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    spec = [paddle.static.InputSpec(list(shape), 'float32')]
+    path = ponnx.export(net, os.path.join(tmp, 'model'), input_spec=spec)
+    assert path.endswith('.onnx') and os.path.getsize(path) > 0
+    blob = open(path, 'rb').read()
+    x = np.random.RandomState(seed).rand(*shape).astype('float32')
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    got = ponnx.reference_run(blob, [x])[0]
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-4)
+    return blob
+
+
+def test_lenet_export_parity():
+    from paddle_tpu.vision import models as vm
+    blob = _roundtrip(vm.LeNet(), (1, 1, 28, 28))
+    m = ponnx.parse_model(blob)
+    ops = {n['op_type'] for n in m['nodes']}
+    # the real graph structure is there: convs, pools, matmuls
+    assert {'Conv', 'MaxPool', 'MatMul'} <= ops
+    assert m['opset'] == [13]
+    assert m['inputs'] == ['input_0']
+
+
+def test_resnet18_export_parity():
+    from paddle_tpu.vision import models as vm
+    _roundtrip(vm.resnet18(), (1, 3, 64, 64), atol=1e-4)
+
+
+def test_mlp_with_activations_parity():
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8),
+                        nn.Sigmoid(), nn.Linear(8, 4), nn.Softmax())
+    _roundtrip(net, (3, 8))
+
+
+def test_export_writes_native_artifacts_too():
+    import paddle_tpu.nn as nn
+    net = nn.Linear(4, 2)
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    base = os.path.join(tmp, 'lin')
+    ponnx.export(net, base,
+                 input_spec=[paddle.static.InputSpec([2, 4], 'float32')])
+    assert os.path.exists(base + '.onnx')
+    # the native serving bundle still ships alongside (jit.save path)
+    assert os.path.exists(base + '.pdmodel') or \
+        os.path.exists(base + '.pdexec') or \
+        os.path.exists(base + '.stablehlo')
+
+
+def test_unsupported_op_raises_clearly():
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+
+    class SortNet(nn.Layer):
+        def forward(self, x):
+            from paddle_tpu.core.dispatch import apply_op
+            return apply_op(lambda v: jnp.sort(v, axis=-1), x)
+
+    with pytest.raises(Exception) as ei:
+        _roundtrip(SortNet(), (2, 8))
+    assert 'sort' in str(ei.value).lower() or 'support' in str(ei.value)
+
+
+def test_wire_format_roundtrip():
+    """The hand-rolled protobuf writer re-parses exactly."""
+    from paddle_tpu.onnx import _proto as P
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    name, back = P.parse_tensor(P.tensor('w', arr))
+    assert name == 'w'
+    np.testing.assert_array_equal(back, arr)
+    nd = P.parse_node(P.node('Conv', ['x', 'w'], ['y'],
+                             strides=[2, 2], group=1))
+    assert nd['op_type'] == 'Conv' and nd['attrs']['strides'] == [2, 2]
+    assert nd['inputs'] == ['x', 'w'] and nd['outputs'] == ['y']
+
+
+def test_scan_model_refuses_loudly():
+    """A lax.scan body must NOT be inlined once (silently wrong); the
+    exporter refuses with guidance (review r4 finding)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.dispatch import apply_op
+
+    class ScanNet(nn.Layer):
+        def forward(self, x):
+            def body(v):
+                out, _ = jax.lax.scan(lambda c, _: (c * 2 + 1, None), v,
+                                      None, length=3)
+                return out
+            return apply_op(body, x)
+
+    with pytest.raises(ponnx.OnnxExportError, match='scan'):
+        _roundtrip(ScanNet(), (2, 4))
+
+
+def test_shared_jitted_subfn_not_stale_folded():
+    """A jitted helper called on a constant then on a live input shares one
+    traced jaxpr; the second inline must not reuse the first call's folded
+    constants (review r4 finding)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.dispatch import apply_op
+
+    doubler = jax.jit(lambda v: v * 2.0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter(
+                [4], default_initializer=paddle.nn.initializer.Constant(3.0))
+
+        def forward(self, x):
+            return apply_op(lambda x, w: doubler(w) + doubler(x), x, self.w)
+
+    _roundtrip(Net(), (4,), seed=3)
+
+
+def test_rem_mod_semantics():
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.dispatch import apply_op
+
+    class RemNet(nn.Layer):
+        def forward(self, x):
+            return apply_op(lambda v: jnp.asarray(
+                jax.lax.rem(v - 0.5, jnp.float32(0.3))), x)
+
+    import jax
+    _roundtrip(RemNet(), (8,), seed=4)
