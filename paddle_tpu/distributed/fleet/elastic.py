@@ -1,0 +1,177 @@
+"""Elastic membership + rescale decisions.
+
+Reference: python/paddle/distributed/fleet/elastic/__init__.py (etcd-backed:
+nodes register under a prefix, the manager watches joins/leaves and decides
+to scale the job up/down within [np_min, np_max], restarting training with
+the new world size). TPU-native redesign: no etcd in the stack — membership
+is a SHARED DIRECTORY of heartbeat files (local disk for single-host
+multi-process, NFS/GCS-fuse for pods), which composes with the launcher's
+existing heartbeat liveness machinery instead of adding a second consensus
+system. Liveness == fresh mtime; ordering == sorted node ids (deterministic
+rank assignment on every reconciliation).
+
+    mgr = ElasticManager('/shared/job1', min_nodes=1, max_nodes=4)
+    mgr.register()
+    members = mgr.wait_for_quorum()        # blocks until >= min_nodes
+    ... run a training lifetime ...
+    event = mgr.poll(members)              # 'scale_up' | 'scale_down' | None
+
+``distributed.launch --elastic_dir ... --np MIN[:MAX]`` drives this loop:
+on any scale event the local process group is stopped and relaunched with
+re-ranked PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, resuming from the latest
+checkpoint (same recovery path as crash/hang restarts).
+"""
+import os
+import threading
+import time
+import uuid
+
+
+class ElasticManager:
+    def __init__(self, root, node_id=None, heartbeat_interval=1.0,
+                 stale_after=None, min_nodes=1, max_nodes=None):
+        self.root = root
+        self.node_id = node_id or f'{int(time.time() * 1e3):x}-{uuid.uuid4().hex[:6]}'
+        self.interval = heartbeat_interval
+        self.stale_after = stale_after or heartbeat_interval * 5
+        self.min_nodes = max(1, min_nodes)
+        self.max_nodes = max_nodes
+        self._stop = threading.Event()
+        self._thread = None
+        self._seq = 0
+        # liveness is judged by heartbeat CONTENT progress against THIS
+        # manager's own clock (seq unchanged for stale_after => stale):
+        # immune to writer/reader clock skew and NFS mtime quirks that a
+        # plain mtime comparison would trip over
+        self._seen = {}                       # nid -> (content, t_observed)
+
+    # ---- membership ----------------------------------------------------
+    def _path(self, nid):
+        return os.path.join(self.root, f'member_{nid}')
+
+    def _done_path(self, nid):
+        return os.path.join(self.root, f'done_{nid}')
+
+    def register(self):
+        os.makedirs(self.root, exist_ok=True)
+        self._touch()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def _touch(self):
+        self._seq += 1
+        tmp = self._path(self.node_id) + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write(str(self._seq))
+        os.replace(tmp, self._path(self.node_id))
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._touch()
+            except OSError:
+                pass
+
+    def mark_done(self):
+        """Record CLEAN job completion: peers must not treat this node's
+        departure as a failure/scale event (see poll)."""
+        try:
+            with open(self._done_path(self.node_id), 'w') as f:
+                f.write('done')
+        except OSError:
+            pass
+
+    def deregister(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+        try:
+            os.remove(self._path(self.node_id))
+        except OSError:
+            pass
+
+    def done_members(self):
+        try:
+            return {fn[len('done_'):] for fn in os.listdir(self.root)
+                    if fn.startswith('done_')}
+        except OSError:
+            return set()
+
+    def live_members(self):
+        """Sorted node ids with a progressing heartbeat (deterministic
+        ranks)."""
+        now = time.time()
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for fn in names:
+            if not fn.startswith('member_') or fn.endswith('.tmp'):
+                continue
+            nid = fn[len('member_'):]
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    content = f.read()
+            except OSError:
+                continue                      # raced with a deregister
+            prev = self._seen.get(nid)
+            if prev is None or prev[0] != content:
+                self._seen[nid] = (content, now)
+                out.append(nid)
+            elif now - prev[1] <= self.stale_after:
+                out.append(nid)
+        return sorted(out)
+
+    # ---- decisions -----------------------------------------------------
+    def wait_for_quorum(self, timeout=None, poll=None):
+        """Block until at least min_nodes are live; -> member list."""
+        deadline = None if timeout is None else time.time() + timeout
+        poll = poll or self.interval
+        while True:
+            members = self.live_members()
+            if len(members) >= self.min_nodes:
+                return members
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f'elastic: only {len(members)}/{self.min_nodes} nodes '
+                    f'after {timeout}s')
+            time.sleep(poll)
+
+    def effective(self, members):
+        """Members actually admitted to the job (max_nodes cap; overflow
+        nodes stay registered as hot spares)."""
+        return members[:self.max_nodes] if self.max_nodes else list(members)
+
+    def poll(self, prev_members):
+        """One reconciliation against the membership seen at launch:
+        -> 'scale_up' | 'scale_down' | 'lost_quorum' | None. A peer that
+        marked itself DONE (clean exit) is no failure and no scale event —
+        the job is finishing, this node's group is left to complete."""
+        done = self.done_members()
+        cur = self.effective(self.live_members())
+        prev = self.effective(list(prev_members))
+        if set(cur) - set(prev) - done:
+            return 'scale_up'
+        missing = set(prev) - set(cur) - done
+        if missing and len(cur) >= self.min_nodes:
+            return 'scale_down'
+        if missing and len(cur) < self.min_nodes:
+            return 'lost_quorum'
+        return None
+
+    def rank_of(self, members):
+        eff = self.effective(members)
+        return eff.index(self.node_id) if self.node_id in eff else None
+
+
+def parse_np(spec):
+    """'2' -> (2, 2); '1:4' -> (1, 4) (reference --np MIN[:MAX] syntax)."""
+    if spec is None:
+        return None, None
+    s = str(spec)
+    if ':' in s:
+        lo, hi = s.split(':', 1)
+        return int(lo), int(hi)
+    return int(s), int(s)

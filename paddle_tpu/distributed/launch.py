@@ -56,6 +56,14 @@ def _parse(argv=None):
     p.add_argument('--heartbeat_timeout', type=float, default=0.0,
                    help='seconds of heartbeat-file staleness before the '
                         'child is declared hung and restarted; 0 disables')
+    # elastic membership (reference fleet/elastic --np + etcd; here a
+    # shared membership directory — see fleet/elastic.py)
+    p.add_argument('--elastic_dir', default=None,
+                   help='shared membership directory enabling elastic '
+                        'scale up/down across launchers')
+    p.add_argument('--np', dest='np_spec', default=None,
+                   help='MIN[:MAX] node count for elastic mode')
+    p.add_argument('--elastic_poll', type=float, default=1.0)
     p.add_argument('--log_dir', default=None)
     p.add_argument('training_script')
     p.add_argument('training_script_args', nargs=argparse.REMAINDER)
@@ -74,10 +82,12 @@ def _kill(proc):
 _shutdown_requested = False
 
 
-def _run_group(cmd, envs, hb_paths, hb_timeout):
+def _run_group(cmd, envs, hb_paths, hb_timeout, stop_check=None):
     """One lifetime of the local process group. All-or-nothing (elastic
     restarts are whole-group, like the reference): first nonzero exit or
-    stale heartbeat kills the rest. Returns (exit_code | None, hung)."""
+    stale heartbeat kills the rest. Returns (exit_code | None, hung,
+    stop_reason). ``stop_check()`` (elastic membership poll) may return a
+    reason string to gracefully stop the group for a rescale."""
     procs = []
     for env, hb in zip(envs, hb_paths):
         if hb:
@@ -97,9 +107,11 @@ def _run_group(cmd, envs, hb_paths, hb_timeout):
 
     live = set(range(len(procs)))
     poll_s = min(hb_timeout / 4.0, 5.0) if hb_timeout > 0 else 1.0
+    if stop_check is not None:
+        poll_s = min(poll_s, 0.5)
     while live:
         time.sleep(poll_s if len(live) < len(procs) or hb_timeout > 0
-                   else 0.2)
+                   or stop_check is not None else 0.2)
         for i in sorted(live):
             code = procs[i].poll()
             if code is not None:
@@ -107,7 +119,15 @@ def _run_group(cmd, envs, hb_paths, hb_timeout):
                 if code != 0:
                     for j in live:
                         _kill(procs[j])
-                    return code, False
+                    return code, False, None
+        if stop_check is not None:
+            reason = stop_check()
+            if reason:
+                print(f'[launch] elastic: {reason} — stopping group for '
+                      'rescale', file=sys.stderr)
+                for j in live:
+                    _kill(procs[j])
+                return None, False, reason
         if hb_timeout > 0:
             for i in sorted(live):
                 hb = hb_paths[i]
@@ -121,8 +141,31 @@ def _run_group(cmd, envs, hb_paths, hb_timeout):
                           'killing', file=sys.stderr)
                     for j in live:
                         _kill(procs[j])
-                    return None, True
-    return 0, False
+                    return None, True, None
+    return 0, False, None
+
+
+def _build_envs(args, nproc, nnodes, node_rank):
+    total = nnodes * nproc
+    master = args.master
+    if not master and nnodes == 1 and nproc > 1:
+        # single-node multi-process: localhost coordinator is correct.
+        # Multi-NODE without --master stays unset so init_parallel_env
+        # skips jax.distributed (a loud fast misconfig, not a silent hang
+        # against the wrong host's localhost).
+        master = '127.0.0.1'
+    envs = []
+    for local_rank in range(nproc):
+        env = dict(os.environ)
+        env['PADDLE_TRAINERS_NUM'] = str(total)
+        env['PADDLE_TRAINER_ID'] = str(node_rank * nproc + local_rank)
+        env['PADDLE_LOCAL_RANK'] = str(local_rank)
+        if master:
+            host, _, port = master.partition(':')
+            env['PADDLE_MASTER'] = host
+            env['MASTER_PORT'] = port or '8476'
+        envs.append(env)
+    return envs
 
 
 def main(argv=None):
@@ -133,25 +176,6 @@ def main(argv=None):
         nproc = len([d for d in args.device_list.split(',') if d != ''])
     else:
         nproc = 1
-    total = args.nnodes * nproc
-    master = args.master
-    if not master and args.nnodes == 1 and nproc > 1:
-        # single-node multi-process: localhost coordinator is correct.
-        # Multi-NODE without --master stays unset so init_parallel_env
-        # skips jax.distributed (a loud fast misconfig, not a silent hang
-        # against the wrong host's localhost).
-        master = '127.0.0.1'
-    envs = []
-    for local_rank in range(nproc):
-        env = dict(os.environ)
-        env['PADDLE_TRAINERS_NUM'] = str(total)
-        env['PADDLE_TRAINER_ID'] = str(args.node_rank * nproc + local_rank)
-        env['PADDLE_LOCAL_RANK'] = str(local_rank)
-        if master:
-            host, _, port = master.partition(':')
-            env['PADDLE_MASTER'] = host
-            env['MASTER_PORT'] = port or '8476'
-        envs.append(env)
     hb_paths = [None] * nproc
     if args.heartbeat_timeout > 0:
         base = args.log_dir or '/tmp'
@@ -159,22 +183,62 @@ def main(argv=None):
         hb_paths = [os.path.join(base, f'paddle_hb_{os.getpid()}_{r}')
                     for r in range(nproc)]
 
+    mgr = None
+    if args.elastic_dir:
+        from .fleet.elastic import ElasticManager, parse_np
+        np_min, np_max = parse_np(args.np_spec)
+        mgr = ElasticManager(args.elastic_dir,
+                             heartbeat_interval=args.elastic_poll,
+                             min_nodes=np_min or 1, max_nodes=np_max)
+        mgr.register()
+
     restarts = 0
-    while True:
-        cmd = ([sys.executable, args.training_script]
-               + args.training_script_args)
-        start = time.time()
-        code, hung = _run_group(cmd, envs, hb_paths, args.heartbeat_timeout)
-        if code == 0:
-            return 0
-        if _shutdown_requested:
-            sys.exit(code if code is not None else 1)
-        if restarts >= args.max_restarts:
-            sys.exit(code if code is not None else 1)
-        restarts += 1
-        why = 'hung (heartbeat stale)' if hung else f'exited {code}'
-        print(f'[launch] group {why} after {time.time()-start:.0f}s; '
-              f'restart {restarts}/{args.max_restarts}', file=sys.stderr)
+    try:
+        while True:
+            if mgr is not None:
+                members = mgr.wait_for_quorum()
+                eff = mgr.effective(members)
+                rank = mgr.rank_of(members)
+                if rank is None:          # hot spare beyond max_nodes
+                    time.sleep(args.elastic_poll)
+                    continue
+                nnodes, node_rank = len(eff), rank
+                print(f'[launch] elastic lifetime: {nnodes} node(s), '
+                      f'this is rank {node_rank}', file=sys.stderr)
+                stop_check = lambda: mgr.poll(members)   # noqa: E731
+            else:
+                nnodes, node_rank = args.nnodes, args.node_rank
+                stop_check = None
+            envs = _build_envs(args, nproc, nnodes, node_rank)
+            cmd = ([sys.executable, args.training_script]
+                   + args.training_script_args)
+            start = time.time()
+            code, hung, rescale = _run_group(cmd, envs, hb_paths,
+                                             args.heartbeat_timeout,
+                                             stop_check=stop_check)
+            if code == 0:
+                if mgr is not None:
+                    # clean completion: tell peers this is NOT a node loss
+                    mgr.mark_done()
+                return 0
+            if _shutdown_requested:
+                sys.exit(code if code is not None else 1)
+            if rescale:
+                # membership changed: relaunch with re-ranked world —
+                # does NOT consume a crash-restart budget slot
+                print(f'[launch] rescale ({rescale}) after '
+                      f'{time.time() - start:.0f}s; relaunching',
+                      file=sys.stderr)
+                continue
+            if restarts >= args.max_restarts:
+                sys.exit(code if code is not None else 1)
+            restarts += 1
+            why = 'hung (heartbeat stale)' if hung else f'exited {code}'
+            print(f'[launch] group {why} after {time.time()-start:.0f}s; '
+                  f'restart {restarts}/{args.max_restarts}', file=sys.stderr)
+    finally:
+        if mgr is not None:
+            mgr.deregister()
 
 
 if __name__ == '__main__':

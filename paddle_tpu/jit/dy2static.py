@@ -362,7 +362,7 @@ def _mods_of(*stmt_lists):
     # while-form loop index, and the return-lowering result carrier —
     # those are genuine branch/loop-carried state
     keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx',
-            f'{_GEN_PREFIX}rv')
+            f'{_GEN_PREFIX}rv', f'{_GEN_PREFIX}attr')
     return sorted(n for n in names
                   if not n.startswith(_GEN_PREFIX) or n.startswith(keep))
 
@@ -713,6 +713,210 @@ class _BreakContinueTransformer(ast.NodeTransformer):
                 _assign(fb, _const(False)), _assign(fc, _const(False)), loop]
 
 
+def _slot_key(node):
+    """Canonical identity of an attribute/subscript slot expression. Only
+    the OUTERMOST node's ctx differs between a store target and a read, so
+    dumping the (always-Load) inner parts directly is ctx-insensitive
+    without any copying."""
+    if isinstance(node, ast.Attribute):
+        return f'{ast.dump(node.value)}.{node.attr}'
+    return f'{ast.dump(node.value)}[{ast.dump(node.slice)}]'
+
+
+class _SlotRewriter(ast.NodeTransformer):
+    """Replace every read/write of the planned slots with their temp name."""
+
+    def __init__(self, plan):
+        self.plan = plan               # slot key -> gen name
+
+    def _swap(self, node):
+        if isinstance(node.ctx, ast.Del):
+            return None        # `del slot` is never lowered (plan excludes)
+        gen = self.plan.get(_slot_key(node))
+        if gen is None:
+            return None
+        return ast.copy_location(
+            ast.Name(id=gen, ctx=type(node.ctx)()), node)
+
+    def visit_Attribute(self, node):
+        got = self._swap(node)
+        if got is not None:
+            return got
+        self.generic_visit(node)
+        return node
+
+    def visit_Subscript(self, node):
+        got = self._swap(node)
+        if got is not None:
+            return got
+        self.generic_visit(node)
+        return node
+
+    def visit_FunctionDef(self, node):      # inner scopes untouched
+        return node
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
+class _ComplexStoreLowering(ast.NodeTransformer):
+    """Attribute/subscript-store support inside convertible control flow
+    (VERDICT r3 'Next' #6, second half; the reference's dygraph_to_static
+    handles these through variable-scope snapshots).
+
+    ``self.n = self.n + 1`` inside a tensor-conditioned branch/loop is
+    lowered by LOCALIZING the slot: read it into a temp before the
+    construct, rewrite every read/write of that slot inside the construct
+    to the temp (so it becomes ordinary branch/loop-carried state the
+    if/while converters already handle), and write the temp back after:
+
+        _pt_attrN = self.n            # UNDEF if the slot doesn't exist yet
+        <construct, with self.n -> _pt_attrN>
+        if _pt_attrN is not UNDEF: self.n = _pt_attrN
+
+    Equivalent under plain-Python execution for direct slot access (same
+    reads through the literal expression, same final store). KNOWN
+    DIVERGENCES (shared with the reference's scope-snapshot approach):
+    reads through an ALIAS of the slot inside the construct (a method call
+    that reads self.n, passing the dict to a helper) see the pre-construct
+    value until the write-back; property setters fire once at write-back,
+    not per store; an exception escaping the construct skips the
+    write-back. Unsafe cases stay on the unsupported-construct error: a
+    slot whose index/object names are rebound inside the construct, a slot
+    also stored inside a NESTED python loop (per-iteration slot identity
+    can change there), or a `del` of the slot."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _gen(self):
+        self._uid += 1
+        return f'{_GEN_PREFIX}attr{self._uid}'
+
+    # ---- collection ------------------------------------------------------
+    @staticmethod
+    def _targets_of(s):
+        if isinstance(s, ast.Assign):
+            return s.targets
+        if isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            return [s.target]
+        return []
+
+    def _scan(self, stmts, shallow, in_loop, loop_stored):
+        for s in stmts or []:
+            for t in self._targets_of(s):
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    key = _slot_key(t)
+                    if in_loop:
+                        loop_stored.add(key)
+                    else:
+                        shallow.setdefault(key, t)
+            if isinstance(s, ast.Delete):
+                # `del slot` cannot be expressed by the write-back: any
+                # deleted slot is unsafe to localize at this level
+                for t in s.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        loop_stored.add(_slot_key(t))
+            if isinstance(s, (_INNER_SCOPES)):
+                continue
+            nested_loop = in_loop or isinstance(s, (ast.For, ast.While))
+            for attr in ('body', 'orelse', 'finalbody'):
+                self._scan(getattr(s, attr, None), shallow, nested_loop,
+                           loop_stored)
+            for h in getattr(s, 'handlers', []) or []:
+                self._scan(h.body, shallow, nested_loop, loop_stored)
+
+    def _lower(self, node, blocks):
+        shallow, loop_stored = {}, set()
+        for blk in blocks:
+            self._scan(blk, shallow, False, loop_stored)
+        if not shallow:
+            return node
+        assigned = set()
+        for blk in blocks:
+            assigned |= _BodyInfo().run(blk).assigned
+        if isinstance(node, ast.For):
+            assigned |= _BodyInfo().run([node]).assigned  # the loop target
+        plan = {}
+        for key, t in shallow.items():
+            if key in loop_stored:
+                continue                 # also stored per-iteration: unsafe
+            slot_names = {n.id for sub in ([t.value] + (
+                [t.slice] if isinstance(t, ast.Subscript) else []))
+                for n in ast.walk(sub) if isinstance(n, ast.Name)}
+            if slot_names & assigned:
+                continue                 # slot identity changes inside
+            plan[key] = (self._gen(), t)
+        if not plan:
+            return node
+        rw = _SlotRewriter({k: g for k, (g, _) in plan.items()})
+        for blk in blocks:
+            blk[:] = [rw.visit(s) for s in blk]
+        if isinstance(node, (ast.While, ast.For)):
+            node.test = rw.visit(node.test) if isinstance(
+                node, ast.While) else node.test
+        import copy
+        pre, post = [], []
+        undef = ast.Attribute(value=_load(_RT_NAME), attr='UNDEF',
+                              ctx=ast.Load())
+        for key, (gen, t) in plan.items():
+            read = copy.deepcopy(t)
+            for sub in ast.walk(read):
+                if isinstance(sub, (ast.Name, ast.Attribute, ast.Subscript)):
+                    sub.ctx = ast.Load()
+            pre.append(ast.Try(
+                body=[_assign(gen, read)],
+                handlers=[ast.ExceptHandler(
+                    type=_load('Exception'), name=None,
+                    body=[_assign(gen, copy.deepcopy(undef))])],
+                orelse=[], finalbody=[]))
+            store_t = copy.deepcopy(t)
+            store_t.ctx = ast.Store()
+            # NameError-tolerant: the converters del UNDEF-valued temps
+            # after an untaken python branch (unbound-semantics restore)
+            post.append(ast.Try(
+                body=[ast.If(
+                    test=ast.Compare(left=_load(gen), ops=[ast.IsNot()],
+                                     comparators=[copy.deepcopy(undef)]),
+                    body=[ast.Assign(targets=[store_t], value=_load(gen))],
+                    orelse=[])],
+                handlers=[ast.ExceptHandler(
+                    type=_load('NameError'), name=None,
+                    body=[ast.Pass()])],
+                orelse=[], finalbody=[]))
+        return pre + [node] + post
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        return self._lower(node, [node.body, node.orelse])
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._lower(node, [node.body, node.orelse])
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        return self._lower(node, [node.body, node.orelse])
+
+    def visit_FunctionDef(self, node):
+        # only the OUTER function being converted: process its statements
+        # but do not descend into nested defs (fresh scopes)
+        if getattr(self, '_entered', False):
+            return node
+        self._entered = True
+        new = []
+        for s in node.body:
+            r = self.visit(s)
+            new.extend(r if isinstance(r, list) else [r])
+        node.body = new
+        return node
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._uid = 0
@@ -885,6 +1089,7 @@ def convert_control_flow(fn):
         _ReturnLowering().run(fdef)
         bc = _BreakContinueTransformer()
         bc.visit(fdef)
+        _ComplexStoreLowering().visit(fdef)
         # hoist flag/index defaults to the function top: enclosing converted
         # constructs then always see these generated names bound, so they
         # never surface in a user-facing unbound-variable error
