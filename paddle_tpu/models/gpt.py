@@ -38,6 +38,11 @@ class GPTConfig:
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    # GQA/MQA: kv heads (0 = MHA, one kv head per query head). Must divide
+    # num_heads; with tensor parallel, mp must divide it too. The flash
+    # kernels serve each kv head to its query group without repeating KV,
+    # and the decode cache shrinks by num_heads/num_kv_heads.
+    num_kv_heads: int = 0
     ffn_mult: int = 4
     max_seq_len: int = 1024
     dropout: float = 0.0
@@ -58,10 +63,31 @@ class GPTConfig:
     # 'gpipe': fwd scan + autodiff reverse pipeline (stores O(m) stage inputs)
     # '1f1b':  fused fwd/bwd schedule, O(pp) in-flight activations
     pp_schedule: str = 'gpipe'
+    # blockwise LM-head cross-entropy chunk (0 disables): the loss streams
+    # vocab chunks with an online logsumexp instead of materializing
+    # [B,S,V] f32 logits (ops/xent.py). Auto-falls back when the vocab
+    # doesn't tile or under mp/sp/pp sharded losses.
+    xent_chunk: int = 8192
+
+    def __post_init__(self):
+        kvh = self.num_kv_heads or self.num_heads
+        if self.num_heads % kvh != 0:
+            raise ValueError(
+                f'num_kv_heads={kvh} must divide num_heads={self.num_heads}')
+        if self.mp > 1 and (kvh % self.mp != 0
+                            or self.num_heads % self.mp != 0):
+            raise ValueError(
+                f'mp={self.mp} must divide both num_heads='
+                f'{self.num_heads} and num_kv_heads={kvh} (each tensor-'
+                'parallel rank owns whole kv heads with their query groups)')
 
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
 
     @property
     def ffn_size(self):
@@ -84,9 +110,12 @@ def init_params(config: GPTConfig, key):
         return (scale * jax.random.normal(kk, shape)).astype(pdt)
 
     kb = _split(next(k), 6)
+    # GQA: per-kv-head packing [q_0..q_{g-1}|k|v] -> (g+2)*kv_heads*hd cols
+    qkv_cols = (config.num_heads + 2 * config.kv_heads) * config.head_dim
     blocks = {
         'ln1_g': jnp.ones((L, h), pdt), 'ln1_b': jnp.zeros((L, h), pdt),
-        'qkv_w': nrm(kb[0], (L, h, 3 * h)), 'qkv_b': jnp.zeros((L, 3 * h), pdt),
+        'qkv_w': nrm(kb[0], (L, h, qkv_cols)),
+        'qkv_b': jnp.zeros((L, qkv_cols), pdt),
         'proj_w': nrm(kb[1], (L, h, h), std / math.sqrt(2 * L)),
         'proj_b': jnp.zeros((L, h), pdt),
         'ln2_g': jnp.ones((L, h), pdt), 'ln2_b': jnp.zeros((L, h), pdt),
@@ -134,8 +163,12 @@ def _layer_norm(x, g, b, eps=1e-5):
 
 
 def _attention(q, k, v, config, mesh=None):
-    """q/k/v: [B, S, H, D]."""
+    """q: [B, S, H, D]; k/v: [B, S, H_kv, D] (GQA: H_kv divides H). The
+    flash kernels serve kv groups natively; the ring and einsum fallbacks
+    repeat kv heads."""
     if config.sp > 1:
+        from ..ops.flash_attention import repeat_kv
+        k, v = repeat_kv(k, v, int(q.shape[2]))
         from ..parallel.ring_attention import (ring_attention,
                                                ring_flash_available,
                                                ring_flash_attention)
@@ -151,6 +184,8 @@ def _attention(q, k, v, config, mesh=None):
                 return flash_attention(q, k, v, causal=True)
         except Exception:
             pass
+    from ..ops.flash_attention import repeat_kv
+    k, v = repeat_kv(k, v, int(q.shape[2]))
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
     S = q.shape[1]
@@ -160,15 +195,19 @@ def _attention(q, k, v, config, mesh=None):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
-def _block_qkv(bp, y, nh, hd, cdt):
+def _block_qkv(bp, y, nh, hd, cdt, kvh=None):
     """Fused QKV projection shared by the train block and the KV-cache
-    decode block. Head-major packing [q_i|k_i|v_i] per head: an 'mp' column
-    shard is then exactly that rank's heads (contiguous [Q|K|V] thirds
-    would hand each rank a mix of Q and K columns)."""
+    decode block. Packing is per KV HEAD: [q_0..q_{g-1}|k|v] (g = query
+    group size; g=1 is classic head-major MHA) — an 'mp' column shard is
+    then exactly that rank's kv heads with their query groups (contiguous
+    [Q|K|V] thirds would hand each rank a mix of Q and K columns)."""
     B, S, _ = y.shape
+    kvh = nh if kvh is None else kvh
+    g = nh // kvh
     qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
-    qkv = qkv.reshape(B, S, nh, 3, hd)
-    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    qkv = qkv.reshape(B, S, kvh, g + 2, hd)
+    q = qkv[..., :g, :].reshape(B, S, nh, hd)
+    return q, qkv[..., g, :], qkv[..., g + 1, :]
 
 
 def _block_mlp(bp, y, cdt):
@@ -188,6 +227,7 @@ def block_fn(bp, x, config, explicit_mp=False):
     B, S, h = x.shape
     mp = config.mp if explicit_mp else 1
     nh, hd = config.num_heads // mp, config.head_dim
+    kvh = config.kv_heads // mp
 
     if mp > 1:
         from ..parallel.tp_ad import f_identity, g_allreduce
@@ -195,7 +235,7 @@ def block_fn(bp, x, config, explicit_mp=False):
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     if mp > 1:
         y = f_identity(y, 'mp')
-    q, k, v = _block_qkv(bp, y, nh, hd, cdt)
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt, kvh)
     a = _attention(q, k, v, config).reshape(B, S, h // mp)
     a = a @ bp['proj_w'].astype(cdt)
     if mp > 1:
@@ -212,8 +252,8 @@ def block_fn(bp, x, config, explicit_mp=False):
     return x
 
 
-def forward(params, tokens, config: GPTConfig):
-    """tokens: [B, S] int32 -> logits [B, S, V]. lax.scan over stacked blocks."""
+def forward_hidden(params, tokens, config: GPTConfig):
+    """tokens: [B, S] int32 -> final hidden states [B, S, H] (pre-LM-head)."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     pos = jnp.arange(S)
@@ -228,12 +268,27 @@ def forward(params, tokens, config: GPTConfig):
         return body(bp, carry), None
 
     x, _ = jax.lax.scan(scan_body, x, params['blocks'])
-    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
-    logits = x @ params['wte'].T.astype(cdt)
-    return logits
+    return _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+
+
+def forward(params, tokens, config: GPTConfig):
+    """tokens: [B, S] int32 -> logits [B, S, V]. lax.scan over stacked blocks."""
+    x = forward_hidden(params, tokens, config)
+    return x @ params['wte'].T.astype(x.dtype)
 
 
 def loss_fn(params, tokens, targets, config: GPTConfig):
+    if (config.xent_chunk and config.mp == 1 and config.sp == 1
+            and config.pp == 1
+            and config.vocab_size % config.xent_chunk == 0):
+        # blockwise LM-head loss: never materializes [B,S,V] logits (the
+        # other HBM hog besides attention) — see ops/xent.py
+        from ..ops.xent import softmax_xent_blockwise
+        x = forward_hidden(params, tokens, config)
+        B, S, H = x.shape
+        return softmax_xent_blockwise(x.reshape(B * S, H), params['wte'],
+                                      targets.reshape(B * S),
+                                      config.xent_chunk)
     logits = forward(params, tokens, config)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -256,7 +311,7 @@ def init_kv_cache(config: GPTConfig, batch):
     """-> {'k','v': [L, B, S_max, H, Dh] in the compute dtype}."""
     cdt = jnp.dtype(config.dtype)
     shape = (config.num_layers, batch, config.max_seq_len,
-             config.num_heads, config.head_dim)
+             config.kv_heads, config.head_dim)
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
@@ -281,15 +336,17 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
         # pallas decode kernel: streams only cache blocks up to ``pos``
         a = flash_decode(q, k_cache, v_cache, pos).reshape(B, T, h)
     else:
+        from ..ops.flash_attention import repeat_kv
+        k_cache_a, v_cache_a = repeat_kv(k_cache, v_cache, int(q.shape[2]))
         S = k_cache.shape[1]
         scale = 1.0 / math.sqrt(q.shape[-1])
-        s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache) * scale  # [B,H,T,S]
+        s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache_a) * scale  # [B,H,T,S]
         q_pos = pos + jnp.arange(T)[:, None]                    # [T,1]
         k_pos = jnp.arange(S)[None, :]                          # [1,S]
         s = jnp.where((k_pos <= q_pos)[None, None], s.astype(jnp.float32),
                       jnp.float32(-1e30))
         p = jax.nn.softmax(s, axis=-1).astype(cdt)
-        a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache).reshape(B, T, h)
+        a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache_a).reshape(B, T, h)
     return (x + a @ proj_w.astype(cdt) + proj_b.astype(cdt),
             k_cache, v_cache)
 
@@ -298,7 +355,8 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config):
     """One block over a [B, T, H] slice starting at ``pos``."""
     cdt = jnp.dtype(config.dtype)
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
-    q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt)
+    q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt,
+                         config.kv_heads)
     x, k_cache, v_cache = cached_attention(
         x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)

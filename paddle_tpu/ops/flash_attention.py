@@ -104,13 +104,16 @@ def _normalize_key_mask(mask, b, s_k, h=None):
 def flash_attention_available(q, k, v, mask):
     """Use the kernels for shapes they handle natively on TPU: self- or
     cross-attention, any seq length (padded to block multiples internally),
-    optional key-padding mask. Dense [.., S_q, S_k] additive masks and
-    GQA/MQA head layouts still route to the XLA path."""
+    optional key-padding mask, GQA/MQA (kv heads dividing q heads — the
+    kernels SHARE each kv row across its query group via block index maps,
+    never materializing repeated KV). Dense [.., S_q, S_k] additive masks
+    still route to the XLA path."""
     if not _HAS_PALLAS or not _platform_ok():
         return False
     b, s_q, h, d = (int(x) for x in q.shape)
     s_k = int(k.shape[1])
-    if int(k.shape[2]) != h:                      # GQA/MQA: jnp path
+    h_kv = int(k.shape[2])
+    if h_kv == 0 or h % h_kv != 0 or int(v.shape[2]) != h_kv:
         return False
     if mask is not None and not _key_mask_normalizable(mask, b, s_k):
         return False
@@ -196,8 +199,11 @@ def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
     lse_ref[0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
-def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1):
-    """q: [BH, S_q, D]; k/v: [BH, S_k, D] -> (out [BH,S_q,D], lse [BH,S_q]).
+def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
+               g=1):
+    """q: [BH, S_q, D]; k/v: [BH//g, S_k, D] (g = query-group size, GQA)
+    -> (out [BH,S_q,D], lse [BH,S_q]). Each kv row serves its g query heads
+    via the block index map — repeated KV is never materialized.
     kmask: additive f32 [B, S_k] (BH = B*h, mask row b//h) or None."""
     bh, s_q, d = q.shape
     s_k = int(k.shape[1])
@@ -208,8 +214,10 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1):
                                has_kmask=kmask is not None)
     in_specs = [
         pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, _np.int32(0))),
-        pl.BlockSpec((1, s_k, d), lambda b, i: (b, _np.int32(0), _np.int32(0))),
-        pl.BlockSpec((1, s_k, d), lambda b, i: (b, _np.int32(0), _np.int32(0))),
+        pl.BlockSpec((1, s_k, d),
+                     lambda b, i: (b // g, _np.int32(0), _np.int32(0))),
+        pl.BlockSpec((1, s_k, d),
+                     lambda b, i: (b // g, _np.int32(0), _np.int32(0))),
     ]
     args = [q, k, v]
     if kmask is not None:
@@ -234,8 +242,20 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1):
 
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                   kmask=None, h=1):
-    """Blockwise gradients (scan over k-blocks), fp32 accumulation."""
+                   kmask=None, h=1, groups=1):
+    """Blockwise gradients (scan over k-blocks), fp32 accumulation.
+    GQA (groups>1): kv repeated across the group here (fallback path),
+    group-partial dk/dv summed at the end."""
+    if groups > 1:
+        kx = jnp.repeat(k, groups, axis=0)
+        vx = jnp.repeat(v, groups, axis=0)
+        dq, dkp, dvp = _bwd_blockwise(q, kx, vx, out, lse, g, causal,
+                                      q_off=q_off, kv_valid=kv_valid,
+                                      kmask=kmask, h=h)
+        shp = (k.shape[0], groups) + tuple(k.shape[1:])
+        dk = dkp.astype(jnp.float32).reshape(shp).sum(1).astype(k.dtype)
+        dv = dvp.astype(jnp.float32).reshape(shp).sum(1).astype(v.dtype)
+        return dq, dk, dv
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     scale = 1.0 / math.sqrt(d)
@@ -384,29 +404,36 @@ def bwd_broadcasts(out, lse, g):
 
 
 def _bwd_pallas(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                kmask=None, h=1):
+                kmask=None, h=1, groups=1):
     """Flash backward via the two-kernel pallas split; fp32 accumulation."""
     lse_b, dta_b = bwd_broadcasts(out, lse, g)
     return _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=q_off,
-                           kv_valid=kv_valid, kmask=kmask, h=h)
+                           kv_valid=kv_valid, kmask=kmask, h=h,
+                           groups=groups)
 
 
 def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
-                    kmask=None, h=1):
-    """Backward kernels with the lse/delta broadcasts precomputed."""
+                    kmask=None, h=1, groups=1):
+    """Backward kernels with the lse/delta broadcasts precomputed.
+
+    GQA (groups>1): k/v have BH//groups rows. dq streams the shared kv row
+    via the index map; the dk/dv kernel runs per QUERY head producing group
+    partials that are summed (f32) into the kv-head gradient."""
     bh, s_q, d = q.shape
     s_k = int(k.shape[1])
     scale = 1.0 / math.sqrt(d)
     has_kmask = kmask is not None
 
     full = lambda b, i: (b, _np.int32(0), _np.int32(0))
+    kvfull = lambda b, i: (b // groups, _np.int32(0), _np.int32(0))
+    kvblk = lambda b, i: (b // groups, i, _np.int32(0))
     blk = lambda b, i: (b, i, _np.int32(0))
     mrow = lambda b, i: (b // h, _np.int32(0))
 
     dq_in_specs = [
         pl.BlockSpec((1, _BQ, d), blk),          # q
-        pl.BlockSpec((1, s_k, d), full),         # k
-        pl.BlockSpec((1, s_k, d), full),         # v
+        pl.BlockSpec((1, s_k, d), kvfull),       # k
+        pl.BlockSpec((1, s_k, d), kvfull),       # v
         pl.BlockSpec((1, _BQ, d), blk),          # g
         pl.BlockSpec((1, _BQ, _LANES), blk),     # lse
         pl.BlockSpec((1, _BQ, _LANES), blk),     # delta
@@ -428,8 +455,8 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
 
     dkv_in_specs = [
         pl.BlockSpec((1, s_q, d), full),         # q
-        pl.BlockSpec((1, _BK, d), blk),          # k
-        pl.BlockSpec((1, _BK, d), blk),          # v
+        pl.BlockSpec((1, _BK, d), kvblk),        # k
+        pl.BlockSpec((1, _BK, d), kvblk),        # v
         pl.BlockSpec((1, s_q, d), full),         # g
         pl.BlockSpec((1, s_q, _LANES), full),    # lse
         pl.BlockSpec((1, s_q, _LANES), full),    # delta
@@ -454,31 +481,36 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
         ],
         interpret=_INTERPRET,
     )(*dkv_args)
+    if groups > 1:
+        shp = (bh // groups, groups, s_k, d)
+        dk = dk.astype(jnp.float32).reshape(shp).sum(1).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(shp).sum(1).astype(v.dtype)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, kmask, causal, q_off, kv_valid, h):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kmask, causal, q_off, kv_valid, h, groups):
     out, _ = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                        kmask=kmask, h=h)
+                        kmask=kmask, h=h, g=groups)
     return out
 
 
-def _flash_f(q, k, v, kmask, causal, q_off, kv_valid, h):
+def _flash_f(q, k, v, kmask, causal, q_off, kv_valid, h, groups):
     out, lse = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                          kmask=kmask, h=h)
+                          kmask=kmask, h=h, g=groups)
     return out, (q, k, v, kmask, out, lse)
 
 
-def _flash_b(causal, q_off, kv_valid, h, res, g):
+def _flash_b(causal, q_off, kv_valid, h, groups, res, g):
     q, k, v, kmask, out, lse = res
     if os.environ.get('PADDLE_TPU_FLASH_JNP_BWD') == '1':
         dq, dk, dv = _bwd_blockwise(q, k, v, out, lse, g, causal,
                                     q_off=q_off, kv_valid=kv_valid,
-                                    kmask=kmask, h=h)
+                                    kmask=kmask, h=h, groups=groups)
     else:
         dq, dk, dv = _bwd_pallas(q, k, v, out, lse, g, causal, q_off=q_off,
-                                 kv_valid=kv_valid, kmask=kmask, h=h)
+                                 kv_valid=kv_valid, kmask=kmask, h=h,
+                                 groups=groups)
     dmask = None if kmask is None else jnp.zeros_like(kmask)
     return dq, dk, dv, dmask
 
@@ -506,8 +538,19 @@ def lift_mask_4d(m):
     return m
 
 
+def repeat_kv(k, v, n_q_heads):
+    """Materialize GQA kv heads up to ``n_q_heads`` (fallback paths only —
+    the kernels themselves share kv rows via index maps)."""
+    h_kv = int(k.shape[2])
+    if h_kv == n_q_heads:
+        return k, v
+    rep = n_q_heads // h_kv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _jnp_attention(q, k, v, causal, mask):
     """XLA-softmax fallback for shapes the kernels decline ([B,S,H,D])."""
+    k, v = repeat_kv(k, v, int(q.shape[2]))
     d = q.shape[-1]
     scores = jnp.einsum('bqhd,bkhd->bhqk', q, k).astype(jnp.float32)
     scores = scores * (1.0 / math.sqrt(d))
@@ -536,9 +579,11 @@ def flash_attention(q, k, v, causal=False, mask=None):
     this op is always safe to call."""
     b, s_q, hh, d = q.shape
     s_k = int(k.shape[1])
+    h_kv = int(k.shape[2])
     if (not flash_attention_available(q, k, v, mask)
             or (causal and s_q > s_k)):
         return _jnp_attention(q, k, v, causal, mask)
+    groups = hh // h_kv
 
     kmask = (_normalize_key_mask(mask, b, s_k)
              if mask is not None else None)
@@ -547,8 +592,8 @@ def flash_attention(q, k, v, causal=False, mask=None):
     s_k_pad = -(-s_k // _BK) * _BK
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * hh, s_q, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * hh, s_k, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * hh, s_k, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_k, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, s_k, d)
     qt = _pad_seq(qt, s_q_pad)
     kt = _pad_seq(kt, s_k_pad)
     vt = _pad_seq(vt, s_k_pad)
@@ -561,7 +606,7 @@ def flash_attention(q, k, v, causal=False, mask=None):
         else:
             kv_valid = s_k          # static in-kernel bound, no mask array
 
-    out = _flash(qt, kt, vt, kmask, causal, q_off, kv_valid, hh)
+    out = _flash(qt, kt, vt, kmask, causal, q_off, kv_valid, hh, groups)
     out = out[:, :s_q]
     return out.reshape(b, hh, s_q, d).transpose(0, 2, 1, 3)
 
@@ -614,12 +659,13 @@ def _decode_bk(s_max):
 
 def flash_decode_available(q, k_cache):
     """Kernel path for the KV-cache decode loop: q [B,T,H,D] (T small),
-    cache [B,S_max,H,D]."""
+    cache [B,S_max,H_kv,D] (H_kv divides H: GQA/MQA served natively)."""
     if not _HAS_PALLAS or not _platform_ok():
         return False
     b, t, h, d = (int(x) for x in q.shape)
     s_max = int(k_cache.shape[1])
-    if int(k_cache.shape[2]) != h:
+    h_kv = int(k_cache.shape[2])
+    if h_kv == 0 or h % h_kv != 0:
         return False
     return (t <= _TQ_DECODE and s_max % 128 == 0 and s_max >= 128 and
             d in (64, 128, 256) and q.dtype in (jnp.float32, jnp.bfloat16))
@@ -628,23 +674,25 @@ def flash_decode_available(q, k_cache):
 def flash_decode(q, k_cache, v_cache, pos):
     """Attend q rows (absolute positions pos..pos+T-1, ``pos`` a traced i32
     scalar) to cache positions <= each row's own. q: [B,T,H,D], caches
-    [B,S_max,H,D] -> [B,T,H,D]. Inference only (no vjp)."""
+    [B,S_max,H_kv,D] -> [B,T,H,D]. Inference only (no vjp)."""
     b, t, h, d = q.shape
     s_max = int(k_cache.shape[1])
+    h_kv = int(k_cache.shape[2])
+    g = h // h_kv
     bh = b * h
     bk = _decode_bk(s_max)
     qt = q.transpose(0, 2, 1, 3).reshape(bh, t, d)
     qt = _pad_seq(qt, _TQ_DECODE)
-    kt = k_cache.transpose(0, 2, 1, 3).reshape(bh, s_max, d)
-    vt = v_cache.transpose(0, 2, 1, 3).reshape(bh, s_max, d)
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * h_kv, s_max, d)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * h_kv, s_max, d)
     scale = 1.0 / math.sqrt(d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh,),
         in_specs=[
             pl.BlockSpec((1, _TQ_DECODE, d), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, s_max, d), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, s_max, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, s_max, d), lambda b, *_: (b // g, 0, 0)),
+            pl.BlockSpec((1, s_max, d), lambda b, *_: (b // g, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, _TQ_DECODE, d), lambda b, *_: (b, 0, 0)),
     )

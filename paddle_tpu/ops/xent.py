@@ -1,0 +1,112 @@
+"""Blockwise softmax cross-entropy over a large vocabulary.
+
+The LM-head loss is the other HBM hog of GPT training besides attention
+(reference analogue: the fused softmax_with_cross_entropy CUDA kernel,
+paddle/fluid/operators/softmax_with_cross_entropy_op.cu): materializing
+[B, S, V] logits in f32 at the bench config (8x1024x32768) is ~1 GB, plus
+the same again for the softmax backward. This op never materializes more
+than one [B*S, V_chunk] tile:
+
+  forward:  scan over vocab chunks with an online logsumexp (max/sumexp
+            carries) while gathering each target's logit on the fly;
+  backward: recompute each chunk's probabilities from the saved row lse
+            (flash-attention-style residual trick) and accumulate
+            dx += (p - onehot) @ W_chunk,  dW_chunk = (p - onehot)^T x.
+
+Pure lax.scan (no pallas needed: the chunk matmuls are exactly what the
+MXU wants; XLA fuses the elementwise online-softmax updates around them).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG = jnp.float32(-1e30)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def softmax_xent_blockwise(x, w, targets, chunk=8192):
+    """Mean token cross-entropy of logits = x @ w.T against ``targets``.
+
+    x: [N, H] (flattened [B*S, H]) activations; w: [V, H] (tied LM head /
+    wte); targets: [N] int32. chunk must divide V. -> scalar f32 loss.
+    """
+    loss, _ = _fwd(x, w, targets, chunk)
+    return loss
+
+
+def _fwd(x, w, targets, chunk):
+    n, h = x.shape
+    v = w.shape[0]
+    assert v % chunk == 0, f'chunk {chunk} must divide vocab {v}'
+    wc = w.reshape(v // chunk, chunk, h)
+    xf = x.astype(jnp.float32)
+
+    def body(carry, args):
+        m, s, tl = carry
+        w_c, base = args
+        logits = jax.lax.dot_general(
+            xf, w_c.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [N, chunk]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        # target logit if it falls in this chunk
+        local = targets - base
+        in_chunk = (local >= 0) & (local < chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        tl = jnp.where(in_chunk, got, tl)
+        return (m_new, s, tl), None
+
+    m0 = jnp.full((n,), _NEG, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    tl0 = jnp.zeros((n,), jnp.float32)
+    bases = jnp.arange(v // chunk, dtype=jnp.int32) * chunk
+    (m, s, tl), _ = jax.lax.scan(body, (m0, s0, tl0), (wc, bases))
+    lse = m + jnp.log(s)
+    loss = jnp.mean(lse - tl)
+    return loss, (x, w, targets, lse)
+
+
+def _fwd_vjp(x, w, targets, chunk):
+    loss, res = _fwd(x, w, targets, chunk)
+    return loss, res
+
+
+def _bwd_vjp(chunk, res, g):
+    x, w, targets, lse = res
+    n, h = x.shape
+    v = w.shape[0]
+    wc = w.reshape(v // chunk, chunk, h)
+    xf = x.astype(jnp.float32)
+    gn = (g / n).astype(jnp.float32)                     # d(mean)
+
+    def body(dx, args):
+        w_c, base = args
+        logits = jax.lax.dot_general(
+            xf, w_c.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])               # [N, chunk]
+        local = targets - base
+        in_chunk = (local >= 0) & (local < chunk)
+        onehot = (jnp.arange(chunk, dtype=jnp.int32)[None, :]
+                  == jnp.clip(local, 0, chunk - 1)[:, None]) \
+            & in_chunk[:, None]
+        d_logits = (p - onehot.astype(jnp.float32)) * gn  # [N, chunk]
+        dx = dx + jax.lax.dot_general(
+            d_logits, w_c.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            d_logits, xf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [chunk, H]
+        return dx, dw_c
+
+    bases = jnp.arange(v // chunk, dtype=jnp.int32) * chunk
+    dx0 = jnp.zeros((n, h), jnp.float32)
+    dx, dwc = jax.lax.scan(body, dx0, (wc, bases))
+    dw = dwc.reshape(v, h)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+softmax_xent_blockwise.defvjp(_fwd_vjp, _bwd_vjp)

@@ -111,9 +111,12 @@ def test_availability_gate():
     # dense [B,H,S,S] additive masks still decline to the XLA path
     assert not fa.flash_attention_available(
         q, q, q, jnp.ones((1, 2, 512, 512)))
-    # GQA (fewer kv heads) declines
+    # GQA (kv heads dividing q heads) is in-gate since r4
     kv = jnp.zeros((1, 512, 1, 64))
-    assert not fa.flash_attention_available(q, kv, kv, None)
+    assert fa.flash_attention_available(q, kv, kv, None)
+    # non-dividing head counts decline
+    kv3 = jnp.zeros((1, 512, 3, 64))
+    assert not fa.flash_attention_available(q, kv3, kv3, None)
     # unsupported head_dim declines
     bad_d = jnp.zeros((1, 512, 2, 32))
     assert not fa.flash_attention_available(bad_d, bad_d, bad_d, None)
@@ -369,3 +372,79 @@ def test_per_head_mask_declines_and_sdpa_fallback_matches():
                    else with_flash),
         np.asarray(without._value if hasattr(without, '_value')
                    else without), atol=2e-5, rtol=2e-5)
+
+
+# ---- GQA / MQA (r4: kv heads shared across query groups via index maps) ----
+
+def _naive_gqa(q, k, v, causal, mask=None):
+    rep = q.shape[2] // k.shape[2]
+    return _naive_full(q, jnp.repeat(k, rep, axis=2),
+                       jnp.repeat(v, rep, axis=2), causal, mask)
+
+
+@pytest.mark.parametrize('h_kv', [1, 2])
+@pytest.mark.parametrize('causal', [False, True])
+def test_gqa_forward_parity(h_kv, causal):
+    H = 4
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(40), 2, 256, H, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(41), 2, 256, h_kv, 64)
+    assert fa.flash_attention_available(q, k, v, None)
+    got = fa.flash_attention(q, k, v, causal=causal)
+    want = _naive_gqa(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_grad_parity():
+    H, h_kv = 4, 2
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(42), 1, 256, H, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(43), 1, 256, h_kv, 64)
+    tgt = jax.random.normal(jax.random.PRNGKey(44), q.shape)
+
+    def lf(q, k, v):
+        return jnp.sum((fa.flash_attention(q, k, v, causal=True) - tgt) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum((_naive_gqa(q, k, v, True) - tgt) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g1, g2, 'qkv'):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f'd{nm} mismatch')
+
+
+def test_gqa_grad_parity_jnp_bwd(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_FLASH_JNP_BWD', '1')
+    H, h_kv = 4, 1                              # MQA
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(45), 1, 256, H, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(46), 1, 256, h_kv, 64)
+
+    def lf(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(_naive_gqa(q, k, v, True) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_gqa_flash_decode():
+    B, S, H, h_kv, D = 2, 256, 4, 2, 64
+    kc = jax.random.normal(jax.random.PRNGKey(47), (B, S, h_kv, D))
+    vc = jax.random.normal(jax.random.PRNGKey(48), (B, S, h_kv, D))
+    q = jax.random.normal(jax.random.PRNGKey(49), (B, 1, H, D))
+    assert fa.flash_decode_available(q, kc)
+    got = fa.flash_decode(q, kc, vc, jnp.int32(100))
+    kr = jnp.repeat(kc, H // h_kv, axis=2)
+    vr = jnp.repeat(vc, H // h_kv, axis=2)
+    sc = jnp.einsum('bqhd,bkhd->bhqk', q, kr) / np.sqrt(D)
+    sc = jnp.where(jnp.arange(S)[None, None, None, :] <= 100, sc, -1e30)
+    want = jnp.einsum('bhqk,bkhd->bqhd', jax.nn.softmax(sc, -1), vr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
