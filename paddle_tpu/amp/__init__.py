@@ -45,8 +45,16 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 autocast = auto_cast
 
 
+# ops the hook must NEVER intercept: casting the inputs of an explicit
+# dtype conversion would both change its semantics and recurse (the hook's
+# own .astype dispatches the 'cast' op — O2 would loop forever)
+_NEVER_CAST = {'cast', 'to_tensor', 'full', 'full_like', 'arange'}
+_in_hook = False
+
+
 def _maybe_cast_args(fn_name, args):
-    if not _state['enable']:
+    global _in_hook
+    if not _state['enable'] or _in_hook or fn_name in _NEVER_CAST:
         return args
     lp = _state['dtype']
     white = _WHITE | _state.get('white_extra', set())
@@ -62,8 +70,12 @@ def _maybe_cast_args(fn_name, args):
         if hasattr(a, 'dtype') and a.dtype == jnp.float32:
             return a.astype(lp)
         return a
-    return [cast(a) if not isinstance(a, (list, tuple)) else
-            type(a)(cast(x) for x in a) for a in args]
+    _in_hook = True
+    try:
+        return [cast(a) if not isinstance(a, (list, tuple)) else
+                type(a)(cast(x) for x in a) for a in args]
+    finally:
+        _in_hook = False
 
 
 dispatch.amp_cast_hook = _maybe_cast_args

@@ -231,13 +231,39 @@ def save(layer, path, input_spec=None, **configs):
         bd = {n: b._value for n, b in layer.named_buffers()}
         was_training = layer.training
         layer.eval()
+        # a RAW layer with tensor control flow must trace through the
+        # dy2static conversion exactly like the @to_static call path
+        # (reference jit.save converts the forward too). The converted
+        # forward is installed as an INSTANCE attribute for the trace so
+        # layer.__call__ still runs forward pre/post hooks (weight_norm/
+        # spectral_norm recompute weights in a pre-hook — bypassing
+        # __call__ would bake stale weights into the export).
+        import contextlib
+
+        from .dy2static import convert_control_flow
+        fwd_conv = convert_control_flow(layer.forward)
+
+        @contextlib.contextmanager
+        def converted_forward():
+            had = 'forward' in layer.__dict__
+            prev = layer.__dict__.get('forward')
+            object.__setattr__(layer, 'forward', fwd_conv)
+            try:
+                yield
+            finally:
+                if had:
+                    object.__setattr__(layer, 'forward', prev)
+                else:
+                    layer.__dict__.pop('forward', None)
 
         def infer_fn(*xs):
-            out, _ = functional_call(layer, pd, bd, *xs)
+            with converted_forward():
+                out, _ = functional_call(layer, pd, bd, *xs)
             return out
 
         def infer_fn_functional(params, buffers, *xs):
-            out, _ = functional_call(layer, params, buffers, *xs)
+            with converted_forward():
+                out, _ = functional_call(layer, params, buffers, *xs)
             return out
         try:
             _export_artifacts(infer_fn, infer_fn_functional, pd, bd, specs,

@@ -36,6 +36,8 @@ class MoEConfig:
     sp: int = 1
     mp: int = 1
     pp: int = 1
+    # blockwise LM-head cross-entropy chunk (0 disables) — see gpt.GPTConfig
+    xent_chunk: int = 8192
 
     @property
     def head_dim(self):
@@ -102,7 +104,8 @@ def block_fn(bp, carry, config):
     return (x + ff, aux_acc + aux), None
 
 
-def forward(params, tokens, config):
+def forward_hidden(params, tokens, config):
+    """-> (final hidden [B,S,H], aux load-balance loss)."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     x = (jnp.take(params['wte'], tokens, axis=0) +
@@ -112,15 +115,31 @@ def forward(params, tokens, config):
         body = jax.checkpoint(body)
     (x, aux), _ = jax.lax.scan(lambda c, bp: body(bp, c), (x, jnp.zeros((), jnp.float32)),
                                params['blocks'])
-    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
-    return x @ params['wte'].T.astype(cdt), aux
+    return _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt), aux
+
+
+def forward(params, tokens, config):
+    x, aux = forward_hidden(params, tokens, config)
+    return x @ params['wte'].T.astype(x.dtype), aux
 
 
 def loss_fn(params, tokens, targets, config):
+    aux_scale = config.aux_weight / config.num_layers
+    if (config.xent_chunk and config.mp == 1 and config.sp == 1
+            and config.pp == 1
+            and config.vocab_size % config.xent_chunk == 0):
+        # blockwise LM-head loss (ops/xent.py): no [B,S,V] logits in HBM
+        from ..ops.xent import softmax_xent_blockwise
+        x, aux = forward_hidden(params, tokens, config)
+        B, S, H = x.shape
+        ce = softmax_xent_blockwise(x.reshape(B * S, H), params['wte'],
+                                    targets.reshape(B * S),
+                                    config.xent_chunk)
+        return ce + aux_scale * aux
     logits, aux = forward(params, tokens, config)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll) + config.aux_weight * aux / config.num_layers
+    return -jnp.mean(ll) + aux_scale * aux
 
 
 # ---------------------------------------------------------------------------

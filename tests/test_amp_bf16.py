@@ -104,3 +104,28 @@ def test_gradscaler_unscales_before_apply():
         return np.asarray(net.weight.numpy())
 
     np.testing.assert_allclose(train(1.0), train(4096.0), rtol=1e-5)
+
+
+def test_o2_eager_full_training_step():
+    """O2 auto_cast in EAGER mode with scaler + clip + scheduler (advisor-
+    style journey; r4: the cast hook used to recurse on its own cast op)."""
+    import paddle_tpu.nn as nn
+    net = nn.Linear(8, 8)
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=0.1,
+                                                     T_max=10)
+    opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0),
+                                 parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype('f4'))
+    for _ in range(3):
+        with paddle.amp.auto_cast(level='O2'):
+            out = net(x)
+            assert out.dtype == 'bfloat16' or 'bfloat16' in str(out.dtype)
+            loss = (out.astype('float32') ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        sched.step()
+    assert np.isfinite(float(loss._value))

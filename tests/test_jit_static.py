@@ -242,3 +242,58 @@ def test_jit_save_function(tmp_path):
     for b in (1, 5):
         out = np.asarray(pred.run([np.ones((b, 4), 'float32')])[0])
         np.testing.assert_allclose(out, np.full((b, 4), 3.0))
+
+
+def test_save_raw_layer_with_control_flow_then_serve():
+    """jit.save on an UNCONVERTED layer whose forward branches on a tensor
+    must apply dy2static before tracing (r4 journey find), and the saved
+    model must serve through the Predictor."""
+    import os
+    import tempfile
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                return h * 2
+            return h - 1
+
+    net = Net()
+    net.eval()
+    p = os.path.join(tempfile.mkdtemp(), 'cf')
+    paddle.jit.save(net, p,
+                    input_spec=[paddle.static.InputSpec([2, 4], 'float32')])
+    pred = inference.create_predictor(inference.Config(p + '.pdmodel'))
+    x = np.random.RandomState(0).rand(2, 4).astype('float32')
+    out = pred.run([x])[0]
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_save_runs_forward_hooks_weight_norm():
+    """jit.save must trace through layer hooks: a weight_norm'd layer's
+    export depends on weight_g/weight_v, not a stale concrete weight
+    (review r4 finding)."""
+    import os
+    import tempfile
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.utils import weight_norm
+    from paddle_tpu import inference
+
+    net = weight_norm(nn.Linear(4, 3))
+    net.eval()
+    x = np.random.RandomState(0).rand(2, 4).astype('float32')
+    # mutate weight_g AFTER construction so a stale baked weight would differ
+    net.weight_g._replace_value(net.weight_g._value * 2.0)
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    p = os.path.join(tempfile.mkdtemp(), 'wn')
+    paddle.jit.save(net, p,
+                    input_spec=[paddle.static.InputSpec([2, 4], 'float32')])
+    pred = inference.create_predictor(inference.Config(p + '.pdmodel'))
+    np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5)

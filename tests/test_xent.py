@@ -67,3 +67,17 @@ def test_gpt_loss_routes_blockwise_and_matches_naive():
                     jax.tree_util.tree_leaves(gn)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=1e-4)
+
+
+def test_moe_loss_routes_blockwise_and_matches_naive():
+    from paddle_tpu.models import moe_gpt
+    kw = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+              n_experts=2, max_seq_len=16, dtype='float32', remat=False,
+              use_flash=False)
+    cfg_b = moe_gpt.MoEConfig(**kw, xent_chunk=32)
+    cfg_n = moe_gpt.MoEConfig(**kw, xent_chunk=0)
+    params = moe_gpt.init_params(cfg_b, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    lb = moe_gpt.loss_fn(params, toks, toks, cfg_b)
+    ln = moe_gpt.loss_fn(params, toks, toks, cfg_n)
+    np.testing.assert_allclose(float(lb), float(ln), rtol=1e-5)

@@ -26,14 +26,14 @@ for i in $(seq 1 400); do
     rc=$?
     echo "$(date +%H:%M:%S) fast rc=$rc $(cat BENCH_TPU_LIVE.json)" >> "$LOG"
     if [ "$rc" -eq 0 ]; then
-      git add BENCH_TPU_LIVE.json SMOKE_TPU_LIVE.json .tpu_watch_r4.log
+      git add BENCH_TPU_LIVE.json SMOKE_TPU_LIVE.json
       git commit -m "bank live TPU fast-bench result (watcher)" || \
         { sleep 5; git commit -m "bank live TPU fast-bench result (watcher)"; }
       banked=1
       echo "$(date +%H:%M:%S) fast banked — running full bench" >> "$LOG"
       timeout 3600 python bench.py > BENCH_TPU_FULL.json 2>>"$LOG"
       echo "$(date +%H:%M:%S) full rc=$? $(cat BENCH_TPU_FULL.json)" >> "$LOG"
-      git add BENCH_TPU_FULL.json .tpu_watch_r4.log
+      git add BENCH_TPU_FULL.json
       git commit -m "bank live TPU full-bench result (watcher)" || true
       exit 0
     fi
