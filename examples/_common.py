@@ -1,0 +1,15 @@
+"""Shared example plumbing."""
+import jax
+
+
+def add_platform_arg(parser):
+    parser.add_argument(
+        '--platform', default=None,
+        help="force a jax platform (e.g. 'cpu') — the axon TPU plugin "
+             'otherwise wins even over JAX_PLATFORMS, and a dead tunnel '
+             'hangs at first device use')
+
+
+def apply_platform(args):
+    if args.platform:
+        jax.config.update('jax_platforms', args.platform)

@@ -15,12 +15,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 import numpy as np
 
+import jax
+
+from _common import add_platform_arg, apply_platform  # noqa: E402
+
 import paddle_tpu as paddle
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
 
 def main():
     p = argparse.ArgumentParser()
+    add_platform_arg(p)
     p.add_argument('--ckpt', default=None, help='state_dict path (.pdparams)')
     p.add_argument('--tokens', type=int, default=64)
     p.add_argument('--temperature', type=float, default=0.8)
@@ -29,6 +34,7 @@ def main():
     p.add_argument('--hidden', type=int, default=256)
     p.add_argument('--layers', type=int, default=4)
     args = p.parse_args()
+    apply_platform(args)
     if args.hidden < 64 or args.hidden % 64:
         p.error('--hidden must be a positive multiple of 64 (head_dim=64)')
 

@@ -1,9 +1,12 @@
 """Train a GPT LM with hybrid parallelism and the native C++ data pipeline.
 
 Single chip:      python examples/train_gpt.py --steps 50
+Off-chip (CPU):   python examples/train_gpt.py --platform cpu --steps 5
 Virtual 8-dev:    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-                  JAX_PLATFORM_NAME=cpu python examples/train_gpt.py \
+                  python examples/train_gpt.py --platform cpu \
                   --dp 2 --mp 2 --pp 2 --hidden 64 --layers 4 --steps 5
+(--platform cpu is the reliable off-chip switch: the axon TPU plugin wins
+even over JAX_PLATFORMS, and a dead tunnel hangs at first device use.)
 """
 import os
 import sys
@@ -16,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from _common import add_platform_arg, apply_platform  # noqa: E402
+
 import paddle_tpu as paddle
 from paddle_tpu.distributed import fleet
 from paddle_tpu.io.native_loader import LMTokenLoader
@@ -26,6 +31,7 @@ from paddle_tpu.utils.checkpoint import auto_resume
 
 def main():
     p = argparse.ArgumentParser()
+    add_platform_arg(p)
     p.add_argument('--steps', type=int, default=50)
     p.add_argument('--batch', type=int, default=8)
     p.add_argument('--seq', type=int, default=512)
@@ -40,6 +46,7 @@ def main():
     p.add_argument('--lr', type=float, default=3e-4)
     p.add_argument('--ckpt', default=None)
     args = p.parse_args()
+    apply_platform(args)
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {'dp_degree': args.dp, 'mp_degree': args.mp,
