@@ -11,6 +11,7 @@ even over JAX_PLATFORMS, and a dead tunnel hangs at first device use.)
 import os
 import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import argparse
 import time

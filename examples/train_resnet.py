@@ -5,6 +5,7 @@ python examples/train_resnet.py --arch resnet18 --epochs 2
 import os
 import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import argparse
 
