@@ -112,6 +112,28 @@ class KaimingUniform(Initializer):
                                   minval=-limit, maxval=limit)
 
 
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for transposed-conv upsampling
+    (reference: fluid/initializer.py BilinearInitializer — every
+    (out_c, in_c) spatial slice gets the same (K, K) interpolation
+    kernel; pair with lr=0 so upsampling coefficients stay fixed)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(int(d) for d in shape)
+        if len(shape) != 4:
+            raise ValueError('Bilinear initializer needs a 4-D conv '
+                             f'kernel shape, got {shape}')
+        if shape[2] != shape[3]:
+            raise ValueError('Bilinear initializer needs square kernels '
+                             f'(shape[2] == shape[3]), got {shape}')
+        size = shape[3]
+        f = math.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        k = 1 - np.abs(np.arange(size) / f - c)
+        filt = np.outer(k, k).astype('float32')
+        return jnp.broadcast_to(jnp.asarray(filt, dtype), shape)
+
+
 class Assign(Initializer):
     def __init__(self, value, name=None):
         self.value = value

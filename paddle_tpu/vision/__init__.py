@@ -5,10 +5,8 @@ from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
 from .image import set_image_backend, get_image_backend, image_load  # noqa: F401
 
+# transforms/__init__'s __all__ covers the class AND functional APIs
 from .transforms import *  # noqa: F401,F403
-from .transforms.functional import (  # noqa: F401
-    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
-    normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip)
 from .datasets import (  # noqa: F401
     Cifar10, Cifar100, DatasetFolder, FashionMNIST, Flowers, ImageFolder,
     MNIST, VOC2012)

@@ -375,3 +375,185 @@ class DeformConv2D(_Layer):
     def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              mask=mask, **self._attrs)
+
+
+# ---------------------------------------------------------------------------
+# File IO ops (reference: paddle/vision/ops.py read_file/decode_jpeg over
+# the CPU image ops) — host-side by nature, PIL-backed here.
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    """Read a file's raw bytes into a 1-D uint8 tensor."""
+    with open(filename, 'rb') as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    """Decode a JPEG byte tensor (from ``read_file``) to a CHW uint8 tensor.
+    mode: 'unchanged' | 'gray' | 'rgb'."""
+    import io
+
+    from PIL import Image
+
+    raw = np.asarray(x._value if isinstance(x, Tensor) else x,
+                     dtype=np.uint8).tobytes()
+    img = Image.open(io.BytesIO(raw))
+    norm = str(mode).lower()
+    if norm == 'gray':
+        img = img.convert('L')
+    elif norm == 'rgb':
+        img = img.convert('RGB')
+    elif norm != 'unchanged':
+        raise ValueError(f"decode_jpeg: mode must be 'unchanged', 'gray' "
+                         f"or 'rgb', got {mode!r}")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                       # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)          # [C, H, W]
+    return Tensor(jnp.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 loss (reference: paddle/vision/ops.py yolo_loss over the C++
+# yolov3_loss op). Original jnp implementation from the documented
+# semantics: sigmoid-xent on (x, y)/objectness/classes, L1 on (w, h),
+# box-coordinate losses scaled by (2 - w*h), per-gt best-anchor assignment,
+# negatives with decoded-IoU > ignore_thresh exempt from objectness loss.
+# ---------------------------------------------------------------------------
+
+def _sig_xent(logit, target):
+    """Elementwise sigmoid cross-entropy, numerically stable."""
+    return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """-> [N] loss. x: [N, S*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h
+    normalized to [0, 1]); gt_label: [N, B] int; anchors: flat (w, h) pairs
+    in input pixels; anchor_mask: indices of this scale's anchors."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    gb = (gt_box._value if isinstance(gt_box, Tensor)
+          else jnp.asarray(gt_box)).astype(jnp.float32)
+    gl = (gt_label._value if isinstance(gt_label, Tensor)
+          else jnp.asarray(gt_label)).astype(jnp.int32)
+    gs = (jnp.ones(gl.shape, jnp.float32) if gt_score is None else
+          (gt_score._value if isinstance(gt_score, Tensor)
+           else jnp.asarray(gt_score)).astype(jnp.float32))
+    N, _, H, W = xv.shape
+    S = len(anchor_mask)
+    C = int(class_num)
+    xv = xv.reshape(N, S, 5 + C, H, W).astype(jnp.float32)
+    tx, ty = xv[:, :, 0], xv[:, :, 1]          # [N,S,H,W]
+    tw, th = xv[:, :, 2], xv[:, :, 3]
+    tobj = xv[:, :, 4]
+    tcls = xv[:, :, 5:]                        # [N,S,C,H,W]
+    input_w = W * downsample_ratio
+    input_h = H * downsample_ratio
+    all_anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anchors = all_anchors[np.asarray(anchor_mask)]
+
+    # ---- ignore mask: decoded pred boxes vs every gt ------------------
+    sig = jax.nn.sigmoid
+    gx_grid = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy_grid = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (sig(tx) * scale_x_y - 0.5 * (scale_x_y - 1) + gx_grid) / W
+    by = (sig(ty) * scale_x_y - 0.5 * (scale_x_y - 1) + gy_grid) / H
+    aw = jnp.asarray(mask_anchors[:, 0])[None, :, None, None]
+    ah = jnp.asarray(mask_anchors[:, 1])[None, :, None, None]
+    bw = jnp.exp(tw) * aw / input_w
+    bh = jnp.exp(th) * ah / input_h
+
+    def iou_xywh(ax, ay, aw_, ah_, bx_, by_, bw_, bh_):
+        x1 = jnp.maximum(ax - aw_ / 2, bx_ - bw_ / 2)
+        x2 = jnp.minimum(ax + aw_ / 2, bx_ + bw_ / 2)
+        y1 = jnp.maximum(ay - ah_ / 2, by_ - bh_ / 2)
+        y2 = jnp.minimum(ay + ah_ / 2, by_ + bh_ / 2)
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        return inter / jnp.maximum(aw_ * ah_ + bw_ * bh_ - inter, 1e-10)
+
+    # [N, S, H, W, B]
+    iou_all = iou_xywh(bx[..., None], by[..., None], bw[..., None],
+                       bh[..., None],
+                       gb[:, None, None, None, :, 0],
+                       gb[:, None, None, None, :, 1],
+                       gb[:, None, None, None, :, 2],
+                       gb[:, None, None, None, :, 3])
+    gt_valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)          # [N, B]
+    iou_all = jnp.where(gt_valid[:, None, None, None, :], iou_all, 0.0)
+    ignore = jnp.max(iou_all, axis=-1) > ignore_thresh       # [N,S,H,W]
+
+    # ---- positive assignment (vectorized over all B gt slots) ---------
+    # best anchor per gt over ALL anchors (w/h IoU, centered)
+    B = gb.shape[1]
+    gw_pix = gb[..., 2] * input_w       # [N, B]
+    gh_pix = gb[..., 3] * input_h
+    aw_all = jnp.asarray(all_anchors[:, 0])[None, None, :]
+    ah_all = jnp.asarray(all_anchors[:, 1])[None, None, :]
+    inter = jnp.minimum(gw_pix[..., None], aw_all) * \
+        jnp.minimum(gh_pix[..., None], ah_all)
+    union = gw_pix[..., None] * gh_pix[..., None] + aw_all * ah_all - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+
+    mask_vec = jnp.asarray(np.asarray(anchor_mask), jnp.int32)
+    in_mask = best[..., None] == mask_vec[None, None, :]           # [N,B,S]
+    slot = jnp.where(in_mask.any(-1), jnp.argmax(in_mask, -1), -1)
+    use = gt_valid & (slot >= 0)                                   # [N,B]
+    gx, gy = gb[..., 0], gb[..., 1]
+    gw, gh = gb[..., 2], gb[..., 3]
+    gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+    s_ = jnp.maximum(slot, 0)
+    # deterministic first-wins on cell collisions (the C++ op iterates gt
+    # boxes sequentially; XLA scatter with duplicate indices is not)
+    cell = (s_ * H + gj) * W + gi                                  # [N,B]
+    earlier_same = ((cell[:, None, :] == cell[:, :, None])
+                    & use[:, None, :]
+                    & (jnp.arange(B)[None, :] < jnp.arange(B)[:, None])[None])
+    use = use & ~earlier_same.any(-1)
+
+    sel_aw = jnp.asarray(mask_anchors[:, 0])[s_]
+    sel_ah = jnp.asarray(mask_anchors[:, 1])[s_]
+    fx = (gx * W - gi + 0.5 * (scale_x_y - 1)) / scale_x_y
+    fy = (gy * H - gj + 0.5 * (scale_x_y - 1)) / scale_x_y
+    onehot = (jnp.arange(C)[None, None, :]
+              == gl[..., None]).astype(jnp.float32)                # [N,B,C]
+    if use_label_smooth and C > 1:
+        onehot = onehot * (1.0 - 1.0 / C) + (1.0 - onehot) * (1.0 / C)
+
+    # single scatter per target: inactive slots write into a dump column
+    # (gi = W) that is sliced off, so active indices are unique
+    n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    gi_s = jnp.where(use, gi, W)
+    idx = (n_idx, s_, gj, gi_s)
+
+    def put(vals):
+        z = jnp.zeros((N, S, H, W + 1), jnp.float32)
+        return z.at[idx].set(vals.astype(jnp.float32))[..., :W]
+
+    usef = use.astype(jnp.float32)
+    obj_t = put(usef)
+    txt, tyt = put(fx), put(fy)
+    twt = put(jnp.log(jnp.maximum(gw * input_w / sel_aw, 1e-9)))
+    tht = put(jnp.log(jnp.maximum(gh * input_h / sel_ah, 1e-9)))
+    wgt = put(2.0 - gw * gh)
+    scr = put(gs)
+    cls_t = jnp.zeros((N, S, C, H, W + 1), jnp.float32).at[
+        n_idx, s_, :, gj, gi_s].set(onehot)[..., :W]
+
+    pos = obj_t                                            # [N,S,H,W] 0/1
+    score = jnp.where(pos > 0, scr, 1.0)
+    loss_xy = (_sig_xent(tx, txt) + _sig_xent(ty, tyt)) * pos * wgt * score
+    loss_wh = (jnp.abs(tw - twt) + jnp.abs(th - tht)) * pos * wgt * score
+    # objectness: positives regress onto the gt (mixup) score itself
+    # (reference: target = gt_score, 1.0 without mixup); negatives target 0
+    # unless their best decoded IoU exceeds ignore_thresh
+    loss_obj = (_sig_xent(tobj, scr) * pos
+                + _sig_xent(tobj, jnp.zeros_like(tobj))
+                * (1 - pos) * (1 - ignore.astype(jnp.float32)))
+    loss_cls = jnp.sum(_sig_xent(tcls, cls_t), axis=2) * pos * score
+    total = (loss_xy + loss_wh + loss_obj + loss_cls).sum(axis=(1, 2, 3))
+    return Tensor(total)
