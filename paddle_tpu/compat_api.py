@@ -27,7 +27,9 @@ def get_default_dtype():
 
 def add_n(inputs, name=None):
     if isinstance(inputs, Tensor):
-        return inputs
+        # a NEW tensor, never an alias (in-place ops on the result must not
+        # corrupt the input — same invariant as Tensor.t)
+        return apply_op(lambda x: x + 0, inputs)
     return apply_op(lambda xs: sum(jnp.asarray(x) for x in xs), list(inputs))
 
 

@@ -35,6 +35,27 @@ def install():
         if not hasattr(Tensor, name):
             setattr(Tensor, name, getattr(creation, name))
 
+    # remaining reference tensor_method_func names defined outside the
+    # scanned modules (r4 method-audit fill). compat_api only depends on
+    # core, so importing it here is cycle-free; the tensor submodules are
+    # already imported by this package.
+    from .. import compat_api as _compat
+    from . import attribute as _attr, inplace_and_array as _inplace
+    _extra_sources = [_compat, _attr, _inplace, creation] + _METHOD_SOURCES
+    for name in ('add_n', 'diagonal', 'scatter_', 'unique_consecutive',
+                 'unstack', 'kron', 'rank', 'flatten_'):
+        fn = next((getattr(m, name) for m in _extra_sources
+                   if hasattr(m, name)), None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+        elif fn is None:
+            raise AttributeError(f'tensor method {name!r} has no source')
+    # broadcast_shape operates on SHAPES; the only sensible method form
+    # uses self's shape as x_shape
+    if not hasattr(Tensor, 'broadcast_shape'):
+        Tensor.broadcast_shape = (
+            lambda self, y_shape: math.broadcast_shape(self.shape, y_shape))
+
     # paddle method-only names
     Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
     Tensor.cast = Tensor.astype

@@ -160,3 +160,33 @@ def test_fft():
     assert np.allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-5)
     out2 = paddle.fft.rfft(t(x)).numpy()
     assert np.allclose(out2, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_method_bindings_r4():
+    """r4 method audit: every reference tensor_method_func name is callable
+    as a Tensor method."""
+    x = paddle.to_tensor(np.arange(6, dtype='f4').reshape(2, 3))
+    assert int(x.rank()) == 2
+    np.testing.assert_allclose(x.diagonal().numpy(), [0.0, 4.0])
+    assert x.kron(paddle.to_tensor(np.eye(2, dtype='f4'))).shape == [4, 6]
+    parts = x.unstack(axis=0)
+    assert len(parts) == 2 and parts[0].shape == [3]
+    # add_n's single argument is the input (list); the method form passes
+    # self as that argument — and must return a NEW tensor, not an alias
+    s = x.add_n()
+    np.testing.assert_allclose(s.numpy(), x.numpy())
+    s.zero_()
+    assert float(x.numpy().sum()) != 0.0      # input untouched
+    # broadcast_shape method form: self's shape vs the given shape
+    assert x.broadcast_shape([1, 3]) == [2, 3]
+    y = paddle.to_tensor(np.array([1, 1, 2, 2, 3], 'int64'))
+    u = y.unique_consecutive()
+    u0 = u[0] if isinstance(u, (list, tuple)) else u
+    np.testing.assert_array_equal(np.asarray(u0.numpy()), [1, 2, 3])
+    z = paddle.to_tensor(np.zeros((3, 2), 'f4'))
+    z.scatter_(paddle.to_tensor(np.array([1], 'int64')),
+               paddle.to_tensor(np.ones((1, 2), 'f4')))
+    assert float(z.numpy()[1].sum()) == 2.0
+    f = paddle.to_tensor(np.ones((2, 2), 'f4'))
+    f.flatten_()
+    assert f.shape == [4]
