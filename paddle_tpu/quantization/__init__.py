@@ -107,6 +107,11 @@ def quant_post_dynamic(model, sample_inputs=None, batch_nums=8,
                       moving_rate=moving_rate, observe_only=True)
     model.eval()
     seen = 0
+    if callable(sample_inputs):
+        # reference convention: sample_generator is a READER CREATOR (a
+        # function returning a fresh iterator), the same contract as
+        # paddle.reader/DataLoader readers
+        sample_inputs = sample_inputs()
     if sample_inputs is not None:
         for i, batch in enumerate(sample_inputs):
             if i >= batch_nums:

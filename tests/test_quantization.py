@@ -92,3 +92,21 @@ def test_invalid_quant_types_raise():
         ImperativeQuantAware(weight_quantize_type='nope')
     with pytest.raises(ValueError):
         ImperativeQuantAware(activation_quantize_type='nope')
+
+
+def test_ptq_reader_creator_sample_generator():
+    """The reference's sample_generator contract is a READER CREATOR (a
+    callable returning an iterator) — r4 journey found it was iterated
+    directly and crashed."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PostTrainingQuantization
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+
+    def gen():
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            yield paddle.to_tensor(rng.rand(2, 8).astype('f4'))
+
+    qnet = PostTrainingQuantization(net, sample_generator=gen).quantize()
+    out = qnet(paddle.to_tensor(np.random.RandomState(1).rand(2, 8).astype('f4')))
+    assert np.isfinite(np.asarray(out._value)).all()
