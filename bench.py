@@ -504,6 +504,22 @@ def main(fast=False):
         else:
             print(f'decode bench failed: {dnote}', file=sys.stderr)
 
+        fence_ok = 'INVALID' not in out['metric']
+        if not fast and fence_ok:
+            # long-context informational rung: same 337M model at 4k ctx
+            # (flash + remat; exercises the attention kernels where the
+            # S^2 term dominates). Skipped when the sanity fence fired —
+            # the same broken timing would publish a bogus number here.
+            lc = dict(batch=2, seq=4096, hidden=1024, layers=24, heads=16,
+                      vocab=32768, iters=8)
+            lres, lnote = _run_child(['--child-train', json.dumps(lc)],
+                                     CONFIG_TIMEOUT_S)
+            if lres is not None:
+                out['tokens_per_sec_seq4096'] = round(
+                    lres['tokens_per_sec'], 1)
+            else:
+                print(f'long-context rung failed: {lnote}', file=sys.stderr)
+
     print(json.dumps(out))
     return 0
 
