@@ -133,6 +133,8 @@ def _map_reshape(spec, in_shape, out_shape, strict_first=True):
     keeps its size-divisibility (the [B,S,H*D] -> [B,S,H,D] case)."""
     out = [None] * len(out_shape)
     for in_dims, out_dims in _reshape_segments(in_shape, out_shape):
+        if not in_dims or not out_dims:
+            continue            # scalar <-> size-1 expansion: nothing maps
         if len(in_dims) == 1 and len(out_dims) == 1:
             out[out_dims[0]] = spec[in_dims[0]]
         elif len(in_dims) == 1:

@@ -112,3 +112,49 @@ def test_unknown_primitive_is_sound():
 
     plan = complete_shardings(f, (jnp.zeros((4, 8)),), (P('dp', None),))
     assert isinstance(plan.arg_specs[0], P)
+
+
+def test_train_step_completion_including_optimizer_state():
+    """The completion pass handles the FULL training step jaxpr (forward +
+    backward + AdamW update): every param matches the manual Megatron
+    specs and the optimizer moments inherit their params' shardings."""
+    import paddle_tpu as paddle
+
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=2, max_seq_len=16, dtype='float32',
+                        use_flash=False, remat=False, mp=2, xent_chunk=0)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.zeros((4, 16), jnp.int32)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    opt_state = opt.functional_init(params)
+
+    def train_step(params, opt_state, toks):
+        loss, grads = jax.value_and_grad(gpt.loss_fn)(params, toks, toks,
+                                                      cfg)
+        new_p, new_s = opt.functional_apply(params, grads, opt_state, 1e-3)
+        return loss, new_p, new_s
+
+    seeds_p = jax.tree_util.tree_map(lambda _: None, params)
+    seeds_p['wte'] = P('mp', None)
+    seeds_p['blocks']['qkv_w'] = P(None, None, 'mp')
+    seeds_p['blocks']['fc_w'] = P(None, None, 'mp')
+    seeds_s = jax.tree_util.tree_map(lambda _: None, opt_state)
+    plan = complete_shardings(train_step, (params, opt_state, toks),
+                              (seeds_p, seeds_s, P('dp', None)))
+
+    def norm(s):
+        t = tuple(s)
+        while t and t[-1] is None:
+            t = t[:-1]
+        return t
+
+    want = dict((jax.tree_util.keystr(k), v) for k, v in
+                jax.tree_util.tree_flatten_with_path(gpt.param_specs(cfg))[0])
+    got = dict((jax.tree_util.keystr(k), v) for k, v in
+               jax.tree_util.tree_flatten_with_path(plan.arg_specs[0])[0])
+    for k, w in want.items():
+        assert norm(got[k]) == norm(w), f'{k}: {got[k]} != {w}'
+    st = plan.arg_specs[1]
+    assert norm(st['blocks']['qkv_w']['moment1']) == (None, None, 'mp')
+    assert norm(st['blocks']['fc_w']['moment2']) == (None, None, 'mp')
+    assert norm(st['blocks']['proj_w']['moment1']) == (None, 'mp')
