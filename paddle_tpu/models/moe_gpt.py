@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.moe import moe_ffn
-from .gpt import _layer_norm, _attention, cached_attention
+from .gpt import _layer_norm, _attention, _block_qkv, cached_attention
 
 
 @dataclasses.dataclass
@@ -25,6 +25,8 @@ class MoEConfig:
     num_layers: int = 12
     num_heads: int = 12
     n_experts: int = 8
+    # GQA/MQA (0 = MHA); must divide num_heads — see gpt.GPTConfig
+    num_kv_heads: int = 0
     ffn_mult: int = 4
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
@@ -39,9 +41,24 @@ class MoEConfig:
     # blockwise LM-head cross-entropy chunk (0 disables) — see gpt.GPTConfig
     xent_chunk: int = 8192
 
+    def __post_init__(self):
+        kvh = self.num_kv_heads or self.num_heads
+        if self.num_heads % kvh != 0:
+            raise ValueError(
+                f'num_kv_heads={kvh} must divide num_heads={self.num_heads}')
+        if self.mp > 1 and (kvh % self.mp != 0
+                            or self.num_heads % self.mp != 0):
+            raise ValueError(
+                f'mp={self.mp} must divide both num_heads={self.num_heads} '
+                f'and num_kv_heads={kvh}')
+
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
 
     @property
     def ffn_size(self):
@@ -58,9 +75,11 @@ def init_params(config: MoEConfig, key):
     def nrm(kk, shape, scale=std):
         return (scale * jax.random.normal(kk, shape)).astype(pdt)
 
+    qkv_cols = (config.num_heads + 2 * config.kv_heads) * config.head_dim
     blocks = {
         'ln1_g': jnp.ones((L, h), pdt), 'ln1_b': jnp.zeros((L, h), pdt),
-        'qkv_w': nrm(ks[0], (L, h, 3 * h)), 'qkv_b': jnp.zeros((L, 3 * h), pdt),
+        'qkv_w': nrm(ks[0], (L, h, qkv_cols)),
+        'qkv_b': jnp.zeros((L, qkv_cols), pdt),
         'proj_w': nrm(ks[1], (L, h, h)), 'proj_b': jnp.zeros((L, h), pdt),
         'ln2_g': jnp.ones((L, h), pdt), 'ln2_b': jnp.zeros((L, h), pdt),
         'gate_w': nrm(ks[2], (L, h, E), 0.01),
@@ -92,10 +111,8 @@ def block_fn(bp, carry, config):
     B, S, h = x.shape
     nh, hd = config.num_heads, config.head_dim
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
-    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    a = _attention(q.reshape(B, S, nh, hd), k.reshape(B, S, nh, hd),
-                   v.reshape(B, S, nh, hd), config).reshape(B, S, h)
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
+    a = _attention(q, k, v, config).reshape(B, S, h)
     x = x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, aux = moe_ffn(y, bp['gate_w'].astype(cdt),
@@ -155,7 +172,7 @@ def loss_fn(params, tokens, targets, config):
 def init_kv_cache(config: 'MoEConfig', batch):
     cdt = jnp.dtype(config.dtype)
     shape = (config.num_layers, batch, config.max_seq_len,
-             config.num_heads, config.head_dim)
+             config.kv_heads, config.head_dim)
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
@@ -164,8 +181,7 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config):
     B, T, h = x.shape
     nh, hd = config.num_heads, config.head_dim
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
-    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
-    q, k, v = (t.reshape(B, T, nh, hd) for t in jnp.split(qkv, 3, axis=-1))
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
     x, k_cache, v_cache = cached_attention(
         x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
