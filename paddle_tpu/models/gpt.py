@@ -32,6 +32,19 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer, Parameter
 
 
+def validate_gqa(num_heads, num_kv_heads, mp):
+    """Shared GQA/tensor-parallel config contract (GPT + MoE configs)."""
+    kvh = num_kv_heads or num_heads
+    if num_heads % kvh != 0:
+        raise ValueError(
+            f'num_kv_heads={kvh} must divide num_heads={num_heads}')
+    if mp > 1 and (kvh % mp != 0 or num_heads % mp != 0):
+        raise ValueError(
+            f'mp={mp} must divide both num_heads={num_heads} and '
+            f'num_kv_heads={kvh} (each tensor-parallel rank owns whole kv '
+            'heads with their query groups)')
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50304
@@ -70,16 +83,7 @@ class GPTConfig:
     xent_chunk: int = 8192
 
     def __post_init__(self):
-        kvh = self.num_kv_heads or self.num_heads
-        if self.num_heads % kvh != 0:
-            raise ValueError(
-                f'num_kv_heads={kvh} must divide num_heads={self.num_heads}')
-        if self.mp > 1 and (kvh % self.mp != 0
-                            or self.num_heads % self.mp != 0):
-            raise ValueError(
-                f'mp={self.mp} must divide both num_heads='
-                f'{self.num_heads} and num_kv_heads={kvh} (each tensor-'
-                'parallel rank owns whole kv heads with their query groups)')
+        validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
 
     @property
     def head_dim(self):

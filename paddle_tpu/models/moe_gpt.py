@@ -42,15 +42,8 @@ class MoEConfig:
     xent_chunk: int = 8192
 
     def __post_init__(self):
-        kvh = self.num_kv_heads or self.num_heads
-        if self.num_heads % kvh != 0:
-            raise ValueError(
-                f'num_kv_heads={kvh} must divide num_heads={self.num_heads}')
-        if self.mp > 1 and (kvh % self.mp != 0
-                            or self.num_heads % self.mp != 0):
-            raise ValueError(
-                f'mp={self.mp} must divide both num_heads={self.num_heads} '
-                f'and num_kv_heads={kvh}')
+        from .gpt import validate_gqa
+        validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
 
     @property
     def head_dim(self):
