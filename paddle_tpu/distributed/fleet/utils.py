@@ -78,3 +78,28 @@ class LocalFS:
 class HDFSClient(LocalFS):
     def __init__(self, hadoop_home=None, configs=None):
         raise RuntimeError('HDFS unavailable offline; use LocalFS')
+
+
+class DistributedInfer:
+    """Parameter-server distributed-infer utility (reference:
+    fleet/utils/ps_util.py DistributedInfer). PS mode is a documented
+    deliberate scope cut in this collective-only TPU stack (SURVEY §2 row
+    21): the class is accepted for program portability and raises with
+    migration guidance when its PS-specific environment is actually
+    initialized."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.origin_main_program = main_program
+        self.origin_startup_program = startup_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        raise NotImplementedError(
+            'DistributedInfer targets parameter-server deployments, which '
+            'this collective-only TPU stack deliberately does not implement '
+            '(SURVEY §2 row 21). Serve with paddle_tpu.inference.'
+            'create_predictor (single- or multi-chip via jax.sharding) '
+            'instead.')
+
+    def get_dist_infer_program(self):
+        return self.origin_main_program

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.moe import moe_ffn
-from .gpt import _layer_norm, _attention, _block_qkv, cached_attention
+from .gpt import (_layer_norm, _attention, _block_qkv,
+                  cached_attention, validate_gqa)
 
 
 @dataclasses.dataclass
@@ -42,7 +43,6 @@ class MoEConfig:
     xent_chunk: int = 8192
 
     def __post_init__(self):
-        from .gpt import validate_gqa
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
 
     @property

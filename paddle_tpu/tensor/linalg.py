@@ -181,3 +181,7 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
                    fweights=fweights, aweights=aweights)
+
+
+# reference paddle.linalg exports 'inv' as the canonical name
+inv = inverse
