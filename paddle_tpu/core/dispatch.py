@@ -38,10 +38,12 @@ def _map_structure(fn, obj):
 def apply_op(pure_fn, *args, **kwargs):
     """Execute pure_fn on unwrapped args; record tape if needed.
 
-    Tensor leaves may appear at top level of args or one level inside
-    list/tuple args (e.g. concat([t1, t2])).
+    Tensor leaves may appear at top level of args or KWARGS, or one level
+    inside list/tuple values (e.g. concat([t1, t2]),
+    layer_norm(x, shape, weight=w)).
     """
-    diff = []           # list of (path, Tensor)
+    diff = []           # list of (path, Tensor); path[0] is an arg index
+                        # or ('kw', name) addressing a keyword argument
 
     def scan(obj, path):
         if _is_diff_tensor(obj):
@@ -53,9 +55,15 @@ def apply_op(pure_fn, *args, **kwargs):
     if _grad_enabled():
         for i, a in enumerate(args):
             scan(a, (i,))
+        for k, v in kwargs.items():
+            scan(v, (('kw', k),))
+
+    def _unwrapped_kwargs():
+        return {k: _map_structure(_unwrap, v) for k, v in kwargs.items()}
 
     if not diff:
-        out = pure_fn(*_map_structure(_unwrap, list(args)), **kwargs)
+        out = pure_fn(*_map_structure(_unwrap, list(args)),
+                      **_unwrapped_kwargs())
         res = _wrap_outputs(out, node=None)
         _maybe_record_replay(pure_fn, args, kwargs, res)
         return res
@@ -65,17 +73,21 @@ def apply_op(pure_fn, *args, **kwargs):
 
     def substitute(vals):
         new_args = list(_map_structure(_unwrap, list(args)))
+        new_kwargs = _unwrapped_kwargs()
         for path, v in zip(paths, vals):
+            store = new_kwargs if isinstance(path[0], tuple) else new_args
+            key = path[0][1] if isinstance(path[0], tuple) else path[0]
             if len(path) == 1:
-                new_args[path[0]] = v
+                store[key] = v
             else:
-                seq = list(new_args[path[0]])
+                seq = list(store[key])
                 seq[path[1]] = v
-                new_args[path[0]] = seq
-        return new_args
+                store[key] = seq
+        return new_args, new_kwargs
 
     def pure_on_diff(vals):
-        return pure_fn(*substitute(vals), **kwargs)
+        new_args, new_kwargs = substitute(vals)
+        return pure_fn(*new_args, **new_kwargs)
 
     primals = [t._value for t in diff_tensors]
     out, vjp_fn = jax.vjp(pure_on_diff, primals)

@@ -169,7 +169,7 @@ class Executor:
                 leaves.append(t)
                 return
             _, args, kwargs, _, _ = rp
-            for a in args:
+            for a in list(args) + list(kwargs.values()):
                 for x in (a if isinstance(a, (list, tuple)) else (a,)):
                     walk(x)
         for f in fetch_list:
@@ -211,13 +211,14 @@ class Executor:
                     v = fmap[t.name].astype(t.dtype)
                 elif getattr(t, '_replay', None) is not None:
                     fn, args, kwargs, idx, is_seq = t._replay
-                    vals = []
-                    for a in args:
+
+                    def resolve(a):
                         if isinstance(a, (list, tuple)):
-                            vals.append(type(a)(value_of(x) for x in a))
-                        else:
-                            vals.append(value_of(a))
-                    out = fn(*vals, **kwargs)
+                            return type(a)(value_of(x) for x in a)
+                        return value_of(a)
+                    vals = [resolve(a) for a in args]
+                    kvals = {k: resolve(a) for k, a in kwargs.items()}
+                    out = fn(*vals, **kvals)
                     v = out[idx] if is_seq else out
                 else:
                     v = t._value   # unreachable leaf guard

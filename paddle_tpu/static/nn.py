@@ -77,3 +77,382 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
               dtype='float32'):
     w = _make_param(tuple(size), param_attr, I.Normal(0.0, 0.02), dtype)
     return F.embedding(input, w, padding_idx=padding_idx)
+
+
+# ---------------------------------------------------------------------------
+# r4: full paddle.static.nn surface (reference python/paddle/static/nn).
+# Real implementations for everything expressible without LoD tensors;
+# LoD sequence_* / parameter-server ops raise precise migration errors
+# (same policy as fluid.layers — SURVEY §2 row 17/21).
+# ---------------------------------------------------------------------------
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = tuple(int(d) for d in input.shape[begin_norm_axis:])
+    g = _make_param(shape, param_attr, I.Constant(1.0)) if scale else None
+    b = _make_param(shape, bias_attr, I.Constant(0.0)) if shift else None
+    out = F.layer_norm(input, shape, weight=g, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    c = int(input.shape[1] if data_layout == 'NCHW' else input.shape[-1])
+    g = _make_param((c,), param_attr, I.Constant(1.0))
+    b = _make_param((c,), bias_attr, I.Constant(0.0))
+    out = F.group_norm_fn(input, groups, weight=g, bias=b, epsilon=epsilon,
+                          data_format=data_layout)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    c = int(input.shape[1])
+    g = _make_param((c,), param_attr, I.Constant(1.0))
+    b = _make_param((c,), bias_attr, I.Constant(0.0))
+    return F.instance_norm(input, weight=g, bias=b, eps=epsilon)
+
+
+def prelu(x, mode='all', param_attr=None, data_format='NCHW', name=None):
+    if mode == 'all':
+        shape = (1,)
+    elif mode == 'channel':
+        shape = (int(x.shape[1] if data_format == 'NCHW' else x.shape[-1]),)
+    else:                                     # 'element'
+        shape = tuple(int(d) for d in x.shape[1:])
+    a = _make_param(shape, param_attr, I.Constant(0.25))
+    if mode == 'element':
+        # per-element slopes broadcast over the batch dim only (F.prelu's
+        # reshape targets the channel axis and cannot express this)
+        from ..core.dispatch import apply_op
+        return apply_op(
+            lambda xv, av: jnp.where(xv >= 0, xv, av[None] * xv), x, a)
+    return F.prelu(x, a, data_format=data_format)
+
+
+def _deconv_filter_from_output(in_spatial, output_size, stride, padding, nd):
+    """Reference conv*_transpose: when filter_size is None it is derived
+    from output_size (k = out - (in-1)*stride + 2*pad, dilation 1)."""
+    if output_size is None:
+        raise ValueError('conv transpose: provide filter_size or '
+                         'output_size')
+    outs = (output_size,) * nd if isinstance(output_size, int) \
+        else tuple(output_size)
+    strides = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    pads = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    return tuple(int(o) - (int(i) - 1) * st + 2 * pd
+                 for o, i, st, pd in zip(outs, in_spatial, strides, pads))
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format='NCHW', name=None):
+    cin = int(input.shape[1] if data_format == 'NCHW' else input.shape[-1])
+    if filter_size is None:
+        spatial = (input.shape[2:] if data_format == 'NCHW'
+                   else input.shape[1:-1])
+        ks = _deconv_filter_from_output(spatial, output_size, stride,
+                                        padding, 2)
+    else:
+        ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+            else tuple(filter_size)
+    w = _make_param((cin, num_filters // groups) + ks, param_attr,
+                    I.XavierNormal())
+    b = _make_param((num_filters,), bias_attr, I.Constant(0.0))
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format='NCDHW', name=None):
+    cin = int(input.shape[1] if data_format == 'NCDHW' else input.shape[-1])
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr,
+                    I.XavierNormal())
+    b = _make_param((num_filters,), bias_attr, I.Constant(0.0))
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format='NCDHW', name=None):
+    cin = int(input.shape[1] if data_format == 'NCDHW' else input.shape[-1])
+    if filter_size is None:
+        spatial = (input.shape[2:] if data_format == 'NCDHW'
+                   else input.shape[1:-1])
+        ks = _deconv_filter_from_output(spatial, output_size, stride,
+                                        padding, 3)
+    else:
+        ks = (filter_size,) * 3 if isinstance(filter_size, int) \
+            else tuple(filter_size)
+    w = _make_param((cin, num_filters // groups) + ks, param_attr,
+                    I.XavierNormal())
+    b = _make_param((num_filters,), bias_attr, I.Constant(0.0))
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dcn
+    cin = int(input.shape[1])
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr,
+                    I.XavierNormal())
+    b = _make_param((num_filters,), bias_attr, I.Constant(0.0))
+    return _dcn(input, offset, w, bias=b, mask=mask, stride=stride,
+                padding=padding, dilation=dilation, groups=groups,
+                deformable_groups=deformable_groups)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[b, i] = x[b] @ W[i] @ y[b]^T + bias[i] (reference
+    static/nn/common.py bilinear_tensor_product)."""
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = _make_param((size, dx, dy), param_attr, I.XavierNormal())
+    b = _make_param((size,), bias_attr, I.Constant(0.0))
+    from ..core.dispatch import apply_op
+    out = apply_op(lambda xv, yv, wv: jnp.einsum('bd,ide,be->bi', xv, wv, yv),
+                   x, y, w)
+    if b is not None:
+        out = out + b
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral-normalized view of ``weight`` via power iteration
+    (reference static/nn spectral_norm: u/v are persistable params)."""
+    import numpy as _onp
+    shape = tuple(int(d) for d in weight.shape)
+    h = shape[dim]
+    w_dim = 1
+    for i, s in enumerate(shape):
+        if i != dim:
+            w_dim *= s
+    # NOTE vs reference: u/v persist as params but are NOT updated across
+    # steps (the pure trace-replay design has no in-place state); use
+    # power_iters >= 3 for a converged sigma. They carry no gradients,
+    # matching the reference's no-grad treatment of u/v.
+    u = _make_param((h,), None, I.Normal(0.0, 1.0))
+    v = _make_param((w_dim,), None, I.Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    from ..core.dispatch import apply_op
+
+    def norm_fn(wv, uv, vv):
+        import jax as _jax
+        perm = (dim,) + tuple(i for i in range(len(shape)) if i != dim)
+        mat = _jax.lax.stop_gradient(
+            jnp.transpose(wv, perm).reshape(h, w_dim))
+        for _ in range(power_iters):
+            vv = mat.T @ uv
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uv = mat @ vv
+            uv = uv / (jnp.linalg.norm(uv) + eps)
+        sigma = jnp.transpose(wv, perm).reshape(h, w_dim)
+        sigma = (_jax.lax.stop_gradient(uv) @ sigma
+                 @ _jax.lax.stop_gradient(vv))
+        return wv / sigma
+    return apply_op(norm_fn, weight, u, v)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_0=0.9999999,
+              enable_scale_and_shift=False):
+    """Reference data_norm: normalize by accumulated batch statistics kept
+    as three persistable accumulators (batch_size / batch_sum /
+    batch_square_sum)."""
+    ndim = len(input.shape)
+    chan_first = data_layout == 'NCHW' and ndim > 2
+    ax = 1 if chan_first else ndim - 1
+    c = int(input.shape[ax])
+    bsz = _make_param((c,), None, I.Constant(1e4))
+    bsum = _make_param((c,), None, I.Constant(0.0))
+    bsq = _make_param((c,), None, I.Constant(1e4))
+    from ..core.dispatch import apply_op
+
+    def fn(xv, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq - s * mean, epsilon))
+        bshape = [1] * ndim
+        bshape[ax] = c
+        return (xv - mean.reshape(bshape)) * scale.reshape(bshape)
+    out = apply_op(fn, input, bsz, bsum, bsq)
+    return getattr(F, act)(out) if act else out
+
+
+# ---- structured control flow (lax-backed) ---------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Both branches are evaluated and the outputs selected elementwise —
+    numerically identical to the reference's lazy cond for the pure
+    programs this stack traces (and exactly lax.select semantics on TPU)."""
+    import jax
+    from ..core.dispatch import apply_op
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if t_out is None or f_out is None:
+        return t_out if f_out is None else f_out
+
+    flat_t, treedef = jax.tree_util.tree_flatten(
+        t_out, is_leaf=lambda x: isinstance(x, Tensor))
+    flat_f = treedef.flatten_up_to(f_out)
+    outs = [apply_op(lambda p, a, b: jnp.where(p, a, b), pred, a, b)
+            for a, b in zip(flat_t, flat_f)]
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    out = default() if default is not None else None
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        this = fn()
+        out = this if out is None else cond(pred, lambda t=this: t,
+                                            lambda o=out: o)
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    pairs = branch_fns.items() if isinstance(branch_fns, dict) \
+        else list(enumerate(branch_fns)) if branch_fns and callable(
+            branch_fns[0]) else branch_fns
+    from ..tensor.logic import equal
+    return case([(equal(branch_index, int(i)), fn) for i, fn in pairs],
+                default=default)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Reference static while_loop -> the dy2static convert_while runtime
+    (lax.while_loop when the condition is traced, python otherwise)."""
+    from ..jit.dy2static import convert_while
+    names = [f'v{i}' for i in range(len(loop_vars))]
+    outs = convert_while(lambda *vs: cond_fn(*vs),
+                         lambda *vs: tuple(body_fn(*vs)),
+                         names, tuple(loop_vars))
+    return list(outs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run arbitrary Python in the program. The call is recorded like any
+    other op, so the static Executor re-runs it on every fed batch; when
+    the recorded program is jit-compiled the python body rides
+    jax.pure_callback with ``out`` as the result template (required in
+    that case — pass a Tensor/InputSpec-like with .shape/.dtype)."""
+    import jax
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    tmpl = {}     # result template captured from the build-time concrete run
+
+    def pure(*vs):
+        if any(isinstance(v, jax.core.Tracer) for v in vs):
+            if out is not None:
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                shapes = [jax.ShapeDtypeStruct(
+                    tuple(o.shape),
+                    jnp.dtype(str(o.dtype).replace('paddle.', '')))
+                    for o in outs]
+            elif 'spec' in tmpl:
+                shapes = tmpl['spec']
+            else:
+                raise ValueError(
+                    'py_func under a traced program needs `out` (shape/'
+                    'dtype template) to ride jax.pure_callback')
+
+            def host(*hv):
+                res = func(*[Tensor(v) for v in hv])
+                res = res if isinstance(res, (list, tuple)) else [res]
+                import numpy as _np
+                return tuple(_np.asarray(
+                    r._value if isinstance(r, Tensor) else r) for r in res)
+            got = jax.pure_callback(host, tuple(shapes), *vs)
+            many = (isinstance(out, (list, tuple)) if out is not None
+                    else tmpl.get('many', False))
+            return got if many else got[0]
+        res = func(*[Tensor(v) for v in vs])
+        if isinstance(res, (list, tuple)):
+            vals_out = type(res)(r._value if isinstance(r, Tensor) else r
+                                 for r in res)
+            tmpl['many'] = True
+            tmpl['spec'] = tuple(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                                 for v in vals_out)
+            return vals_out
+        v = res._value if isinstance(res, Tensor) else res
+        tmpl['many'] = False
+        tmpl['spec'] = (jax.ShapeDtypeStruct(tuple(v.shape), v.dtype),)
+        return v
+
+    from ..core.dispatch import apply_op
+    return apply_op(pure, *xs)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None, name=None):
+    """Viterbi decode with a learned transition matrix (reference
+    crf_decoding over linear_chain_crf's transition params)."""
+    from ..text import viterbi_decode
+    n_tags = int(input.shape[-1])
+    # lengths are honored by viterbi_decode (pad steps pass state through)
+    trans = param_attr if isinstance(param_attr, Tensor) else _make_param(
+        (n_tags + 2, n_tags), param_attr, I.Normal(0.0, 0.1))
+    # reference layout carries start/stop rows; the core decode uses the
+    # [n_tags, n_tags] interior
+    from ..core.dispatch import apply_op
+    interior = apply_op(lambda t: t[-n_tags:], trans)
+    if input.ndim == 2:
+        from ..tensor.manipulation import unsqueeze, squeeze
+        scores, path = viterbi_decode(unsqueeze(input, 0), interior,
+                                      lengths=length)
+        return squeeze(path, 0)
+    _, path = viterbi_decode(input, interior, lengths=length)
+    return path
+
+
+def _lod_legacy(name_, hint):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            f'static.nn.{name_} operates on fluid LoD (ragged) tensors, '
+            f'which this 2.x TPU stack deliberately does not implement '
+            f'(static shapes are what XLA compiles). {hint}')
+    fn.__name__ = name_
+    return fn
+
+
+for _n, _hint in [
+    ('sequence_concat', 'Pad to dense [B, S, ...] and use paddle.concat.'),
+    ('sequence_conv', 'Use nn.Conv1D over padded dense batches.'),
+    ('sequence_enumerate', 'Use tensor slicing over padded batches.'),
+    ('sequence_expand', 'Use paddle.repeat_interleave on dense tensors.'),
+    ('sequence_expand_as', 'Use paddle.expand_as on dense tensors.'),
+    ('sequence_first_step', 'Index step 0 of the padded batch.'),
+    ('sequence_last_step', 'Gather at lengths-1 on the padded batch.'),
+    ('sequence_pad', 'Batches are already dense; see io.DataLoader collate.'),
+    ('sequence_pool', 'Masked reduce over the padded time axis.'),
+    ('sequence_reshape', 'Use paddle.reshape on dense tensors.'),
+    ('sequence_reverse', 'Use paddle.flip on the time axis.'),
+    ('sequence_scatter', 'Use paddle.scatter on dense tensors.'),
+    ('sequence_slice', 'Use tensor slicing on dense tensors.'),
+    ('sequence_softmax', 'Masked softmax over the padded time axis.'),
+    ('sequence_unpad', 'Keep dense batches + a lengths tensor.'),
+    ('nce', 'Use sampled softmax over dense logits (paddle.nn.functional).'),
+    ('row_conv', 'Use a causal nn.Conv1D.'),
+    ('multi_box_head', 'Compose vision.ops prior boxes + conv heads.'),
+    ('sparse_embedding', 'Parameter-server-only; use nn.Embedding '
+                         '(SURVEY §2 row 21 scope cut).'),
+]:
+    globals()[_n] = _lod_legacy(_n, _hint)

@@ -517,15 +517,29 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         else jnp.asarray(transition_params)
     B, T, N = pot.shape
 
-    def step(carry, emit):
+    lens = None
+    if lengths is not None:
+        lens = (lengths._value if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    def step(carry, inp):
+        emit, t = inp
         score = carry                                   # [B, N]
         cand = score[:, :, None] + trans[None]          # [B, N, N]
         best = jnp.max(cand, axis=1) + emit
         idx = jnp.argmax(cand, axis=1)
+        if lens is not None:
+            # steps past a sequence's length pass the state through so the
+            # final scores/backtrack reflect position length-1, not padding
+            active = (t < lens)[:, None]
+            best = jnp.where(active, best, score)
+            idx = jnp.where(active, idx, jnp.arange(N)[None, :])
         return best, idx
 
     score0 = pot[:, 0]
-    scores, idxs = jax.lax.scan(step, score0, jnp.moveaxis(pot[:, 1:], 1, 0))
+    scores, idxs = jax.lax.scan(
+        step, score0, (jnp.moveaxis(pot[:, 1:], 1, 0),
+                       jnp.arange(1, T, dtype=jnp.int32)))
     final_best = jnp.argmax(scores, axis=-1)
 
     def backtrack(carry, idx_t):

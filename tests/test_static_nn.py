@@ -1,0 +1,162 @@
+"""paddle.static.nn surface (reference python/paddle/static/nn/__init__.py):
+real implementations for the dense ops + structured control flow; precise
+migration errors for the LoD sequence_* legacy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+
+
+def _in_static(fn):
+    paddle.enable_static()
+    try:
+        main, startup = paddle.static.Program(), paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            return fn(main, startup)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_norm_builders():
+    def build(main, startup):
+        x = paddle.static.data('x', [4, 6], 'float32')
+        ln = snn.layer_norm(x)
+        x4 = paddle.static.data('x4', [2, 4, 8, 8], 'float32')
+        gn = snn.group_norm(x4, groups=2)
+        inn = snn.instance_norm(x4)
+        pr = snn.prelu(x4, mode='channel')
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed={'x': np.random.rand(4, 6).astype('f4'),
+                                   'x4': np.random.rand(2, 4, 8, 8).astype('f4')},
+                       fetch_list=[ln, gn, inn, pr])
+        for o in outs:
+            assert np.isfinite(o).all()
+        assert abs(outs[0].mean()) < 1e-5          # layer_norm zero-mean
+    _in_static(build)
+
+
+def test_static_conv_builders():
+    def build(main, startup):
+        x = paddle.static.data('x', [1, 3, 8, 8], 'float32')
+        ct = snn.conv2d_transpose(x, 5, filter_size=2, stride=2)
+        x3 = paddle.static.data('x3', [1, 2, 4, 8, 8], 'float32')
+        c3 = snn.conv3d(x3, 4, filter_size=3, padding=1)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        o1, o2 = exe.run(main,
+                         feed={'x': np.random.rand(1, 3, 8, 8).astype('f4'),
+                               'x3': np.random.rand(1, 2, 4, 8, 8).astype('f4')},
+                         fetch_list=[ct, c3])
+        assert o1.shape == (1, 5, 16, 16)
+        assert o2.shape == (1, 4, 4, 8, 8)
+    _in_static(build)
+
+
+def test_bilinear_tensor_product_and_spectral_norm():
+    def build(main, startup):
+        x = paddle.static.data('x', [3, 4], 'float32')
+        y = paddle.static.data('y', [3, 5], 'float32')
+        btp = snn.bilinear_tensor_product(x, y, size=6)
+        w = paddle.static.data('w', [6, 4], 'float32')
+        sn = snn.spectral_norm(w, power_iters=3)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        wv = rng.rand(6, 4).astype('f4')
+        o1, o2 = exe.run(main, feed={'x': rng.rand(3, 4).astype('f4'),
+                                     'y': rng.rand(3, 5).astype('f4'),
+                                     'w': wv},
+                         fetch_list=[btp, sn])
+        assert o1.shape == (3, 6)
+        # spectral norm: top singular value ~1
+        assert abs(np.linalg.svd(o2, compute_uv=False)[0] - 1.0) < 0.05
+    _in_static(build)
+
+
+def test_static_control_flow():
+    # eager-mode semantics of the same API (the static Executor replays)
+    t = paddle.to_tensor(np.float32(3.0))
+    out = snn.cond(t > 0, lambda: t * 2, lambda: t - 1)
+    assert float(out) == 6.0
+    out2 = snn.switch_case(paddle.to_tensor(np.int32(1)),
+                           {0: lambda: t * 10, 1: lambda: t * 100})
+    assert float(out2) == 300.0
+    i = paddle.to_tensor(np.float32(0.0))
+    [final] = snn.while_loop(lambda i: i < 5, lambda i: (i + 2,), [i])
+    assert float(final) == 6.0
+
+
+def test_py_func_and_crf_decoding():
+    def double(x):
+        return x * 2
+    out = snn.py_func(double, paddle.to_tensor(np.float32(4.0)), None)
+    assert float(out) == 8.0
+
+    pot = paddle.to_tensor(np.random.RandomState(0).rand(2, 5, 3).astype('f4'))
+    trans = paddle.to_tensor(np.random.RandomState(1).rand(3, 3).astype('f4'))
+    path = snn.crf_decoding(pot, trans)
+    assert path.shape == [2, 5]
+
+
+def test_sequence_ops_raise_with_migration_hint():
+    with pytest.raises(NotImplementedError, match='LoD'):
+        snn.sequence_pool(None, 'max')
+    with pytest.raises(NotImplementedError, match='Embedding'):
+        snn.sparse_embedding(None, 8)
+
+
+def test_prelu_element_mode_and_deconv_from_output_size():
+    def build(main, startup):
+        x = paddle.static.data('x', [2, 3, 4, 5], 'float32')
+        pe = snn.prelu(x, mode='element')
+        ct = snn.conv2d_transpose(x, 6, output_size=[8, 10], stride=2)
+        dn = snn.data_norm(x)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        o1, o2, o3 = exe.run(
+            main, feed={'x': np.random.rand(2, 3, 4, 5).astype('f4') - 0.5},
+            fetch_list=[pe, ct, dn])
+        assert o1.shape == (2, 3, 4, 5)
+        assert o2.shape == (2, 6, 8, 10)
+        assert o3.shape == (2, 3, 4, 5) and np.isfinite(o3).all()
+    _in_static(build)
+
+
+def test_py_func_replays_on_fed_data():
+    """py_func must re-run on every fed batch, not bake a build-time
+    constant (review r4 finding)."""
+    def build(main, startup):
+        x = paddle.static.data('x', [2, 3], 'float32')
+        y = snn.py_func(lambda t: t * 3, x, None)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        a = np.ones((2, 3), 'f4')
+        b = np.full((2, 3), 2.0, 'f4')
+        (o1,) = exe.run(main, feed={'x': a}, fetch_list=[y])
+        (o2,) = exe.run(main, feed={'x': b}, fetch_list=[y])
+        np.testing.assert_allclose(o1, a * 3)
+        np.testing.assert_allclose(o2, b * 3)
+    _in_static(build)
+
+
+def test_viterbi_lengths_honored():
+    """Padded steps must not contaminate the decode (review r4 finding)."""
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    pot_short = rng.rand(1, 3, 4).astype('f4')
+    # pad with adversarial emissions that would change the path if scanned
+    pad = np.full((1, 3, 4), 100.0, 'f4') * np.eye(4)[3][None, None, :]
+    pot_padded = np.concatenate([pot_short, pad.astype('f4')], axis=1)
+    trans = rng.rand(4, 4).astype('f4')
+    s_short, p_short = viterbi_decode(paddle.to_tensor(pot_short),
+                                      paddle.to_tensor(trans))
+    s_pad, p_pad = viterbi_decode(paddle.to_tensor(pot_padded),
+                                  paddle.to_tensor(trans),
+                                  lengths=paddle.to_tensor(
+                                      np.array([3], 'int64')))
+    np.testing.assert_allclose(np.asarray(s_short.numpy()),
+                               np.asarray(s_pad.numpy()), rtol=1e-5)
+    np.testing.assert_array_equal(p_short.numpy()[0],
+                                  p_pad.numpy()[0, :3])
