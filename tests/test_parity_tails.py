@@ -268,6 +268,9 @@ def test_reference_all_exports_zero_missing():
         ('nn/functional/__init__.py', 'paddle_tpu.nn.functional'),
         ('nn/initializer/__init__.py', 'paddle_tpu.nn.initializer'),
         ('static/__init__.py', 'paddle_tpu.static'),
+        ('static/nn/__init__.py', 'paddle_tpu.static.nn'),
+        ('optimizer/lr.py', 'paddle_tpu.optimizer.lr'),
+        ('nn/utils/__init__.py', 'paddle_tpu.nn.utils'),
         ('optimizer/__init__.py', 'paddle_tpu.optimizer'),
         ('metric/__init__.py', 'paddle_tpu.metric'),
         ('vision/__init__.py', 'paddle_tpu.vision'),
