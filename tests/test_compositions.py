@@ -100,3 +100,57 @@ def test_hapi_fit_keeps_asp_sparsity():
                                                'check_1d', 2, 4), name
     finally:
         sparsity.ASPHelper.reset()
+
+
+def test_1f1b_pipeline_with_mp_and_gqa_packing():
+    """r4 composition: fused-1F1B pipeline x tensor parallel with the
+    per-kv-head QKV packing."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import gpt
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 2, 'pp_degree': 2,
+                               'mp_degree': 2}
+    topo = fleet.init(is_collective=True, strategy=strategy)
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
+                        num_heads=4, num_kv_heads=2, max_seq_len=16,
+                        dtype='float32', use_flash=False, remat=False,
+                        mp=2, pp=2, n_microbatches=2, pp_schedule='1f1b',
+                        xent_chunk=0)
+    params = gpt.place_params(gpt.init_params(cfg, jax.random.PRNGKey(0)),
+                              cfg, topo.mesh)
+    opt = paddle.optimizer.AdamW(1e-3)
+    step = gpt.make_train_step(cfg, opt, topo.mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    loss, _, _ = step(params, opt.functional_init(params),
+                      jax.random.PRNGKey(2), jnp.asarray(1e-3), toks, toks)
+    assert np.isfinite(float(loss))
+
+
+def test_zero3_with_mqa_and_blockwise_xent():
+    """r4 composition: ZeRO-3 param sharding x MQA x chunked LM-head loss."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import gpt
+    from paddle_tpu.parallel.zero import make_zero_train_step
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 8}
+    topo = fleet.init(is_collective=True, strategy=strategy)
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, num_kv_heads=1, max_seq_len=16,
+                        dtype='float32', use_flash=False, remat=False,
+                        xent_chunk=32)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    opt = paddle.optimizer.AdamW(1e-3)
+    step, init_state = make_zero_train_step(
+        lambda p, toks, tgts: gpt.loss_fn(p, toks, tgts, cfg), opt,
+        topo.mesh, stage=3)
+    p, s = init_state(params)
+    toks = jax.random.randint(jax.random.PRNGKey(4), (8, 16), 0, 64)
+    tb = step.place_batch(toks)
+    losses = []
+    for _ in range(2):
+        loss, p, s = step(p, s, jnp.asarray(1e-3), tb, tb)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[1] < losses[0]
