@@ -19,7 +19,7 @@ from . import fleet  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, set_offload_device, set_pipeline_stage, set_shard_mask,
     shard_op, shard_tensor, split)
-from .fleet import utils  # noqa: F401
+from . import utils  # noqa: F401  (fleet.utils stays at distributed.fleet.utils)
 from . import cloud_utils  # noqa: F401
 from .entry_attr import CountFilterEntry, ProbabilityEntry  # noqa: F401
 from .ps_dataset import BoxPSDataset, InMemoryDataset, QueueDataset  # noqa: F401

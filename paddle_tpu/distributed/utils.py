@@ -1,0 +1,359 @@
+"""paddle.distributed.utils parity: cluster/pod/trainer topology records,
+local-trainer process management, and the MoE global_scatter/global_gather
+collectives.
+
+Reference: python/paddle/distributed/utils.py (the launcher's bookkeeping —
+Cluster/Pod/Trainer descriptions, free-port discovery, local trainer
+spawning/watching — plus the expert-parallel alltoall pair). TPU-native
+notes: process management drives the same PADDLE_TRAINER_* env contract as
+``distributed.launch``; the expert collectives are served by GSPMD dispatch
+in ``parallel.moe`` — the eager forms here cover the reference API for
+single-controller use and point multi-host users at the mesh path.
+"""
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+__all__ = ['get_host_name_ip', 'Trainer', 'get_cluster',
+           'start_local_trainers', 'watch_local_trainers', 'find_free_ports',
+           'JobServer', 'Cluster', 'Pod', 'Hdfs', 'add_arguments',
+           'terminate_local_procs', 'TrainerProc', 'get_logger',
+           'pull_worker_log', 'global_scatter', 'global_gather']
+
+
+def get_logger(log_level=20, name='root'):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            '%(asctime)s-%(levelname)s: %(message)s'))
+        logger.addHandler(h)
+    return logger
+
+
+logger = get_logger(name='paddle_tpu.distributed.utils')
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """-> set of ``num`` currently-free TCP ports."""
+    ports = set()
+    attempts = 0
+    while len(ports) < num and attempts < num * 50:
+        attempts += 1
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(('', 0))
+            ports.add(s.getsockname()[1])
+    return ports if len(ports) == num else None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """Reference helper: register one typed argparse argument."""
+    argparser.add_argument('--' + argname, default=default, type=type,
+                           help=help + f' Default: %(default)s.', **kwargs)
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return bool(self.hdfs_ugi and self.hdfs_name and self.hdfs_path)
+
+    def __eq__(self, other):
+        return (self.hdfs_ugi == other.hdfs_ugi
+                and self.hdfs_name == other.hdfs_name
+                and self.hdfs_path == other.hdfs_path)
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []
+        self.endpoint = None
+        self.rank = None
+
+    def __eq__(self, other):
+        return (self.gpus == other.gpus and self.endpoint == other.endpoint
+                and self.rank == other.rank)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.gpus = []
+
+    def __eq__(self, other):
+        return (self.rank == other.rank and self.id == other.id
+                and self.addr == other.addr and self.port == other.port
+                and self.trainers == other.trainers)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_gpus(self):
+        return ','.join(str(g) for g in self.gpus)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __eq__(self, other):
+        if len(self.pods) != len(other.pods):
+            return False
+        return all(a == b for a, b in zip(self.pods, other.pods))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f'{p.addr}:{p.port}' for p in self.pods]
+
+    def pod(self, pod_id):
+        for p in self.pods:
+            if p.id == pod_id:
+                return p
+        return None
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __eq__(self, other):
+        return self.endpoint == other.endpoint
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, selected_devices=None):
+    """Build the Cluster/Pod/Trainer description. Reference layout: one pod
+    per node; with ``selected_devices``, one trainer PER DEVICE consuming
+    the first len(devices) endpoints (endpoints must cover the devices);
+    without a device list, one trainer per endpoint (the TPU
+    single-controller layout)."""
+    cluster = Cluster(hdfs=None)
+    trainer_rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        eps = trainer_endpoints[node_rank]
+        eps = list(eps) if isinstance(eps, (list, tuple)) else [eps]
+        if selected_devices:
+            devs = list(selected_devices)
+            assert len(eps) >= len(devs), (
+                f'node {ip}: {len(eps)} endpoints cannot host '
+                f'{len(devs)} selected devices')
+            slots = [(eps[i], [devs[i]]) for i in range(len(devs))]
+        else:
+            slots = [(ep, []) for ep in eps]
+        for ep, gpus in slots:
+            t = Trainer()
+            t.endpoint = ep
+            t.rank = trainer_rank
+            t.gpus = gpus
+            trainer_rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    pod = cluster.pod(node_ips.index(node_ip))
+    return cluster, pod
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None):
+    """Spawn one process per trainer in ``pod`` with the PADDLE_TRAINER_*
+    env contract (same contract distributed.launch uses)."""
+    procs = []
+    n = cluster.trainers_nranks()
+    for local_rank, t in enumerate(pod.trainers):
+        env = dict(os.environ)
+        if envs:
+            env.update(envs)
+        env.update({
+            'PADDLE_TRAINER_ID': str(t.rank),
+            'PADDLE_LOCAL_RANK': str(local_rank),
+            'PADDLE_TRAINERS_NUM': str(n),
+            'PADDLE_CURRENT_ENDPOINT': t.endpoint or '',
+            'PADDLE_TRAINER_ENDPOINTS': ','.join(
+                cluster.trainers_endpoints()),
+        })
+        cmd = [sys.executable, '-u', training_script] + list(
+            training_script_args or [])
+        tp = TrainerProc()
+        tp.rank = t.rank
+        tp.local_rank = local_rank
+        tp.cmd = cmd
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            tp.log_fn = open(os.path.join(
+                log_dir, f'workerlog.{local_rank}'), 'a')
+            tp.log_offset = tp.log_fn.tell()
+            tp.proc = subprocess.Popen(cmd, env=env, stdout=tp.log_fn,
+                                       stderr=tp.log_fn)
+        else:
+            tp.proc = subprocess.Popen(cmd, env=env)
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp):
+    if tp.log_fn is None:
+        return
+    try:
+        with open(tp.log_fn.name) as f:
+            f.seek(tp.log_offset)
+            for line in f:
+                sys.stdout.write(line)
+            tp.log_offset = f.tell()
+    except OSError:
+        pass
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll the local trainer processes, streaming their logs: returns the
+    trainers still alive (empty/falsy when all exited cleanly, matching the
+    reference's boolean use). Trainer failure terminates the group and
+    raises SystemExit(1) — the reference's failure signal — so migrated
+    supervisor loops catch it the same way."""
+    alive = []
+    for tp in procs:
+        pull_worker_log(tp)
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            logger.error(f'trainer rank {tp.rank} exited {ret} '
+                         f'(cmd: {tp.cmd})')
+            terminate_local_procs(procs)
+            raise SystemExit(1)
+    return alive
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc is not None and tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 10
+    for tp in procs:
+        if tp.proc is None:
+            continue
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                os.kill(tp.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                tp.proc.wait(timeout=5)      # reap: no zombies in a
+            except subprocess.TimeoutExpired:  # long-lived supervisor
+                pass
+        if tp.log_fn is not None:
+            try:
+                tp.log_fn.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel collectives (reference: global_scatter/global_gather over
+# NCCL alltoall with per-expert counts). Multi-chip expert dispatch in this
+# stack is GSPMD-declarative (parallel.moe — all-to-all falls out of the
+# shardings); these eager forms implement the reference COUNT semantics on
+# the single-controller host so migrated programs run unchanged.
+# ---------------------------------------------------------------------------
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Route rows of ``x`` (grouped by [rank, expert] send counts in
+    local_count) into the order the receiving experts consume them
+    (grouped by global_count). Single-controller: the permutation is
+    computed directly; multi-process topologies use parallel.moe."""
+    import jax
+    from ..core.tensor import Tensor
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            'global_scatter across hosts: use paddle_tpu.parallel.moe '
+            '(GSPMD expert dispatch lowers to all-to-all on ICI)')
+    xv = x._value if isinstance(x, Tensor) else np.asarray(x)
+    lc = np.asarray(local_count._value if hasattr(local_count, '_value')
+                    else local_count).astype(np.int64)
+    # single rank: the receive order equals expert-major order of the send
+    # buffer; rows are already expert-grouped, so scatter is the identity
+    # up to the counts' total
+    total = int(lc.sum())
+    return Tensor(xv[:total])
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (single-controller identity routing)."""
+    import jax
+    from ..core.tensor import Tensor
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            'global_gather across hosts: use paddle_tpu.parallel.moe '
+            '(GSPMD expert combine lowers to all-to-all on ICI)')
+    xv = x._value if isinstance(x, Tensor) else np.asarray(x)
+    gc = np.asarray(global_count._value if hasattr(global_count, '_value')
+                    else global_count).astype(np.int64)
+    total = int(gc.sum())
+    return Tensor(xv[:total])

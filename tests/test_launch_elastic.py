@@ -378,3 +378,57 @@ def test_del_slot_unsupported():
     sf = paddle.jit.to_static(f)
     with pytest.raises(Dy2StaticError):
         sf({'k': None}, paddle.to_tensor(np.float32(1.0)))
+
+
+# ---- distributed.utils (reference python/paddle/distributed/utils.py) ------
+
+def test_distributed_utils_cluster_and_trainers(tmp_path):
+    from paddle_tpu.distributed import utils as dutils
+
+    ports = dutils.find_free_ports(3)
+    assert ports and len(ports) == 3
+
+    ips = ['10.0.0.1', '10.0.0.2']
+    eps = [[f'10.0.0.1:{p}' for p in (6170, 6171)],
+           [f'10.0.0.2:{p}' for p in (6170, 6171)]]
+    cluster, pod = dutils.get_cluster(ips, '10.0.0.2', eps)
+    assert cluster.trainers_nranks() == 4
+    assert cluster.pods_nranks() == 2
+    assert pod.rank == 1 and pod.trainers[0].rank == 2
+    assert cluster.trainers_endpoints()[3] == '10.0.0.2:6171'
+
+    # spawn+watch two real local trainers through the env contract
+    script = tmp_path / 'w.py'
+    script.write_text(
+        "import os, sys\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '2'\n"
+        "print('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+    c2, p2 = dutils.get_cluster(['127.0.0.1'], '127.0.0.1',
+                                [['127.0.0.1:6170', '127.0.0.1:6171']])
+    procs = dutils.start_local_trainers(c2, p2, str(script), [],
+                                        log_dir=str(tmp_path / 'logs'))
+    deadline = time.time() + 60
+    alive = procs
+    while alive and time.time() < deadline:
+        alive = dutils.watch_local_trainers(alive, 2)
+        time.sleep(0.2)
+    assert not alive
+    logs = sorted((tmp_path / 'logs').glob('workerlog.*'))
+    assert len(logs) == 2
+    assert 'rank 0' in logs[0].read_text()
+    dutils.terminate_local_procs(procs)
+
+
+def test_distributed_utils_failure_propagates(tmp_path):
+    from paddle_tpu.distributed import utils as dutils
+    script = tmp_path / 'bad.py'
+    script.write_text("raise SystemExit(3)\n")
+    c, p = dutils.get_cluster(['127.0.0.1'], '127.0.0.1',
+                              [['127.0.0.1:6170']])
+    procs = dutils.start_local_trainers(c, p, str(script), [])
+    deadline = time.time() + 60
+    with pytest.raises(SystemExit):
+        while time.time() < deadline:
+            if not dutils.watch_local_trainers(procs, 1):
+                raise AssertionError('trainer exited 3 but no error raised')
+            time.sleep(0.2)

@@ -284,6 +284,7 @@ def test_reference_all_exports_zero_missing():
         ('distributed/fleet/__init__.py', 'paddle_tpu.distributed.fleet'),
         ('distributed/fleet/utils/__init__.py',
          'paddle_tpu.distributed.fleet.utils'),
+        ('distributed/utils.py', 'paddle_tpu.distributed.utils'),
         ('amp/__init__.py', 'paddle_tpu.amp'),
         ('autograd/__init__.py', 'paddle_tpu.autograd'),
         ('jit/__init__.py', 'paddle_tpu.jit'),
