@@ -92,6 +92,93 @@ def is_first_worker():
     return jax.process_index() == 0
 
 
+def is_worker():
+    """Collective-only stack: every process is a worker (the reference's
+    False case only arises in parameter-server deployments)."""
+    return True
+
+
+def is_server():
+    return False
+
+
+def init_worker():
+    """PS-mode worker bring-up — accepted no-op in this collective-only
+    stack (SURVEY §2 row 21 scope cut), warned once so it is visible."""
+    from .strategy import warn_na_once
+    warn_na_once('ps_init_worker', (
+        'fleet.init_worker is a parameter-server call; this collective-only '
+        'TPU stack has no PS runtime (SURVEY row 21) — training proceeds '
+        'without it.'))
+
+
+def stop_worker():
+    from .strategy import warn_na_once
+    warn_na_once('ps_stop_worker', (
+        'fleet.stop_worker is a parameter-server call; nothing to stop in '
+        'the collective-only TPU stack.'))
+
+
+def init_server(*args, **kwargs):
+    raise NotImplementedError(
+        'fleet.init_server/run_server start a parameter-server process; '
+        'this collective-only TPU stack deliberately has no PS runtime '
+        '(SURVEY §2 row 21). Use collective training (fleet.init('
+        'is_collective=True)) instead.')
+
+
+def run_server(*args, **kwargs):
+    init_server()
+
+
+def save_inference_model(executor, dirname, feeded_var_names, target_vars,
+                         main_program=None, export_for_deployment=True):
+    """Reference fleet.save_inference_model (names + targets) -> the
+    static serving export (which wants the placeholder Variables: they are
+    resolved from the fetch lineage by name)."""
+    import os
+
+    from ...core.tensor import Tensor
+    from ...static import save_inference_model as _sim
+
+    from ...static import walk_program
+    targets = (target_vars if isinstance(target_vars, (list, tuple))
+               else [target_vars])
+    want = set(feeded_var_names)
+    found = {t.name: t for t in walk_program(targets)
+             if getattr(t, 'is_placeholder', False) and t.name in want}
+    missing = want - set(found)
+    if missing:
+        raise ValueError(
+            f'save_inference_model: feed names {sorted(missing)} do not '
+            'appear in the fetch lineage (check feeded_var_names)')
+    feeds = [found[n] for n in feeded_var_names]
+    path_prefix = os.path.join(dirname, 'model')
+    return _sim(path_prefix, feeds, targets, executor,
+                program=main_program)
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    """Persist the Parameters created under ``main_program``'s guard
+    (reference: persistable vars of the main program). Keys are the
+    parameter names when set, else positional WITHIN the program."""
+    import os
+
+    import numpy as np
+
+    from ...framework_io import save as fsave
+    from ...nn.layer_base import Parameter
+    from ...static import default_main_program
+    program = main_program or default_main_program()
+    plist = [p for p in getattr(program, '_params', [])
+             if isinstance(p, Parameter)]
+    os.makedirs(dirname, exist_ok=True)
+    params = {(p.name or f'param_{i}'): np.asarray(p._value)
+              for i, p in enumerate(plist)}
+    fsave(params, os.path.join(dirname, 'persistables.pdparams'))
+    return params
+
+
 def barrier_worker():
     pass
 

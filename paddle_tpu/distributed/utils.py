@@ -258,10 +258,12 @@ def pull_worker_log(tp):
     if tp.log_fn is None:
         return
     try:
-        with open(tp.log_fn.name) as f:
+        # binary read + replace-decoding: a concurrent writer can leave a
+        # split multibyte sequence at the tail
+        with open(tp.log_fn.name, 'rb') as f:
             f.seek(tp.log_offset)
-            for line in f:
-                sys.stdout.write(line)
+            data = f.read()
+            sys.stdout.write(data.decode('utf-8', 'replace'))
             tp.log_offset = f.tell()
     except OSError:
         pass
@@ -336,10 +338,18 @@ def global_scatter(x, local_count, global_count, group=None,
     xv = x._value if isinstance(x, Tensor) else np.asarray(x)
     lc = np.asarray(local_count._value if hasattr(local_count, '_value')
                     else local_count).astype(np.int64)
-    # single rank: the receive order equals expert-major order of the send
-    # buffer; rows are already expert-grouped, so scatter is the identity
-    # up to the counts' total
+    gc = np.asarray(global_count._value if hasattr(global_count, '_value')
+                    else global_count).astype(np.int64)
     total = int(lc.sum())
+    if total != int(xv.shape[0]):
+        raise ValueError(
+            f'global_scatter: local_count sums to {total} but x has '
+            f'{int(xv.shape[0])} rows')
+    if total != int(gc.sum()):
+        raise ValueError(
+            f'global_scatter: local_count sum {total} != global_count sum '
+            f'{int(gc.sum())} on a single rank')
+    # single rank: rows are already expert-grouped — identity routing
     return Tensor(xv[:total])
 
 
@@ -355,5 +365,15 @@ def global_gather(x, local_count, global_count, group=None,
     xv = x._value if isinstance(x, Tensor) else np.asarray(x)
     gc = np.asarray(global_count._value if hasattr(global_count, '_value')
                     else global_count).astype(np.int64)
+    lc = np.asarray(local_count._value if hasattr(local_count, '_value')
+                    else local_count).astype(np.int64)
     total = int(gc.sum())
+    if total != int(xv.shape[0]):
+        raise ValueError(
+            f'global_gather: global_count sums to {total} but x has '
+            f'{int(xv.shape[0])} rows')
+    if total != int(lc.sum()):
+        raise ValueError(
+            f'global_gather: global_count sum {total} != local_count sum '
+            f'{int(lc.sum())} on a single rank')
     return Tensor(xv[:total])

@@ -42,6 +42,7 @@ class Program:
         self.placeholders = {}
         self.random_seed = 0
         self._ops = []              # recorded (fn, inputs, outputs) triples
+        self._params = []           # Parameters created under this guard
 
     def global_block(self):
         return self
@@ -59,6 +60,34 @@ class Program:
 _default_main = Program()
 _default_startup = Program()
 _program_stack = []
+
+
+def iter_replay_inputs(rp):
+    """The input atoms of one recorded op (args + kwargs, one level into
+    list/tuple values) — single owner of the _replay tuple layout."""
+    _, args, kwargs, _, _ = rp
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, (list, tuple)):
+            yield from a
+        else:
+            yield a
+
+
+def walk_program(targets):
+    """DFS over the replay lineage of ``targets``, yielding each Tensor
+    exactly once (placeholders included; recursion-free)."""
+    from ..core.tensor import Tensor
+    seen = set()
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if not isinstance(t, Tensor) or id(t) in seen:
+            continue
+        seen.add(id(t))
+        yield t
+        rp = getattr(t, '_replay', None)
+        if rp is not None:
+            stack.extend(iter_replay_inputs(rp))
 
 
 def default_main_program():

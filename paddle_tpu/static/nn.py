@@ -20,6 +20,10 @@ def _make_param(shape, attr, default_init, dtype='float32'):
     init = attr.initializer or default_init
     p = Parameter(init(tuple(shape), jnp.dtype(dtype)), name=attr.name)
     _param_registry.append(p)
+    from ..utils import misc
+    if misc.in_static_mode():
+        from . import default_main_program
+        default_main_program()._params.append(p)
     return p
 
 

@@ -160,3 +160,42 @@ def test_viterbi_lengths_honored():
                                np.asarray(s_pad.numpy()), rtol=1e-5)
     np.testing.assert_array_equal(p_short.numpy()[0],
                                   p_pad.numpy()[0, :3])
+
+
+def test_fleet_ps_surface_and_save_inference_model(tmp_path):
+    """fleet's PS-era module functions: is_worker/init_worker no-op shims
+    with one-time warnings, loud server errors, and the
+    save_inference_model/save_persistables exports (r4)."""
+    import warnings
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import strategy as strat
+
+    assert fleet.is_worker() and not fleet.is_server()
+    strat._warned_na.discard('ps_init_worker')
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        fleet.init_worker()
+        fleet.init_worker()
+    assert sum('parameter-server' in str(x.message) for x in w) == 1
+    with pytest.raises(NotImplementedError):
+        fleet.run_server()
+
+    def build(main, startup):
+        x = paddle.static.data('x', [2, 4], 'float32')
+        y = snn.fc(x, 3)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        d = str(tmp_path)
+        fleet.save_inference_model(exe, d, ['x'], [y], main_program=main)
+        fleet.save_persistables(exe, d, main)
+        import os
+        assert 'persistables.pdparams' in os.listdir(d)
+        prog, feeds, fetches = paddle.static.load_inference_model(
+            os.path.join(d, 'model'), exe)
+        out, = exe.run(prog, feed={feeds[0]: np.ones((2, 4), 'f4')},
+                       fetch_list=fetches)
+        assert out.shape == (2, 3)
+        with pytest.raises(ValueError, match='lineage'):
+            fleet.save_inference_model(exe, d, ['nope'], [y])
+    _in_static(build)
