@@ -1,0 +1,105 @@
+"""Property-based op parity vs numpy (bounded hypothesis fuzz; mirrors the
+reference's randomized per-op unittests at a higher altitude)."""
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import paddle_tpu as paddle
+
+_FAST = settings(max_examples=25, deadline=None)
+
+shapes = st.lists(st.integers(1, 5), min_size=1, max_size=3).map(tuple)
+
+
+def arr(shape, seed):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape).astype('float32') * 4 - 2)
+
+
+@_FAST
+@given(shape=shapes, seed=st.integers(0, 2**16))
+def test_fuzz_unary(shape, seed):
+    a = arr(shape, seed)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.tanh(x).numpy(), np.tanh(a),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp(a),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(paddle.abs(x).numpy(), np.abs(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.sigmoid(x).numpy(),
+                               1 / (1 + np.exp(-a)), rtol=1e-5, atol=1e-6)
+
+
+@_FAST
+@given(shape=shapes, seed=st.integers(0, 2**16),
+       op=st.sampled_from(['add', 'subtract', 'multiply', 'maximum',
+                           'minimum']))
+def test_fuzz_binary_broadcast(shape, seed, op):
+    a = arr(shape, seed)
+    # broadcastable partner: ones on a random prefix of dims
+    b = arr(shape[-1:], seed + 1)
+    ref = getattr(np, op if op != 'subtract' else 'subtract')
+    got = getattr(paddle, op)(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), ref(a, b), rtol=1e-5, atol=1e-6)
+
+
+@_FAST
+@given(shape=shapes, seed=st.integers(0, 2**16),
+       keep=st.booleans())
+def test_fuzz_reductions(shape, seed, keep):
+    a = arr(shape, seed)
+    x = paddle.to_tensor(a)
+    axis = len(shape) - 1
+    np.testing.assert_allclose(
+        paddle.sum(x, axis=axis, keepdim=keep).numpy(),
+        a.sum(axis=axis, keepdims=keep), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.max(x, axis=axis, keepdim=keep).numpy(),
+        a.max(axis=axis, keepdims=keep), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.mean(x, axis=axis, keepdim=keep).numpy(),
+        a.mean(axis=axis, keepdims=keep), rtol=1e-5, atol=1e-6)
+
+
+@_FAST
+@given(seed=st.integers(0, 2**16), m=st.integers(1, 6), k=st.integers(1, 6),
+       n=st.integers(1, 6))
+def test_fuzz_matmul_grad(seed, m, k, n):
+    """matmul value AND gradient vs the analytic form."""
+    a = arr((m, k), seed)
+    b = arr((k, n), seed + 1)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.to_tensor(b, stop_gradient=False)
+    out = paddle.matmul(x, y)
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.ones((m, n)) @ b.T, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.grad._value),
+                               a.T @ np.ones((m, n)), rtol=1e-5, atol=1e-5)
+
+
+@_FAST
+@given(shape=shapes, seed=st.integers(0, 2**16))
+def test_fuzz_manipulation_roundtrips(shape, seed):
+    a = arr(shape, seed)
+    x = paddle.to_tensor(a)
+    flat = paddle.flatten(x)
+    back = paddle.reshape(flat, list(shape))
+    np.testing.assert_array_equal(back.numpy(), a)
+    perm = list(range(len(shape)))[::-1]
+    np.testing.assert_array_equal(
+        paddle.transpose(paddle.transpose(x, perm), perm).numpy(), a)
+    np.testing.assert_array_equal(paddle.flip(paddle.flip(x, [0]), [0]).numpy(), a)
+
+
+@_FAST
+@given(seed=st.integers(0, 2**16), n=st.integers(2, 16), k=st.integers(1, 8))
+def test_fuzz_topk_sort_consistency(seed, n, k):
+    k = min(k, n)
+    a = arr((n,), seed)
+    x = paddle.to_tensor(a)
+    v, i = paddle.topk(x, k)
+    np.testing.assert_allclose(np.sort(v.numpy())[::-1],
+                               np.sort(a)[::-1][:k], rtol=1e-6)
+    np.testing.assert_allclose(a[i.numpy()], v.numpy(), rtol=1e-6)
