@@ -171,15 +171,15 @@ def _attention(q, k, v, config, mesh=None):
     flash kernels serve kv groups natively; the ring and einsum fallbacks
     repeat kv heads."""
     if config.sp > 1:
-        from ..ops.flash_attention import repeat_kv
-        k, v = repeat_kv(k, v, int(q.shape[2]))
         from ..parallel.ring_attention import (ring_attention,
                                                ring_flash_available,
                                                ring_flash_attention)
-        if config.use_flash and ring_flash_available(q):
+        if config.use_flash and ring_flash_available(q, k):
             # pallas kernels per ring pair: no S_local x S_local scores in
-            # HBM, forward or backward
+            # HBM, forward or backward; GQA kv blocks rotate un-repeated
             return ring_flash_attention(q, k, v, axis_name='sp', causal=True)
+        from ..ops.flash_attention import repeat_kv
+        k, v = repeat_kv(k, v, int(q.shape[2]))
         return ring_attention(q, k, v, axis_name='sp', causal=True)
     if config.use_flash:
         try:

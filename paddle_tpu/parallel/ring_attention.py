@@ -82,10 +82,18 @@ def ring_attention(q, k, v, axis_name='sp', causal=True):
 # against the GLOBAL log-sum-exp, the standard ring-flash-attention split).
 # --------------------------------------------------------------------------
 
-def ring_flash_available(q, axis_name='sp'):
-    """The pallas kernels must tile the LOCAL sequence shard."""
-    from ..ops.flash_attention import flash_attention_available
-    return flash_attention_available(q, q, q, None)
+def ring_flash_available(q, k=None, axis_name='sp'):
+    """The pallas kernels must tile the LOCAL sequence shard EXACTLY (the
+    ring calls the kernel internals directly, without the public wrapper's
+    pad-and-mask) — GQA kv layouts included (the ring then rotates the
+    SMALLER kv blocks)."""
+    from ..ops import flash_attention as _fa_fn  # noqa: F401
+    import sys
+    fa = sys.modules['paddle_tpu.ops.flash_attention']
+    kv = q if k is None else k
+    s_local = int(q.shape[1])
+    return (fa.flash_attention_available(q, kv, kv, None)
+            and s_local % fa._BQ == 0 and s_local % fa._BK == 0)
 
 
 def _bhsd(x):
@@ -99,11 +107,14 @@ def _unbhsd(x, B, H):
 
 
 def _ring_fwd_impl(q, k, v, axis_name, causal):
-    """-> (out [BH,S,D] in q.dtype, lse [BH,S] f32). Layout: kernel-major."""
+    """-> (out [BH,S,D] in q.dtype, lse [BH,S] f32). Layout: kernel-major.
+    GQA: k/v may carry H_kv = H/g heads — the ring rotates those smaller
+    blocks and the kernels serve each kv row to its query group."""
     from ..ops.flash_attention import _flash_fwd
     sp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
+    groups = H // k.shape[2]
     qr, kr, vr = _bhsd(q), _bhsd(k), _bhsd(v)
 
     def skip(_kv):
@@ -111,11 +122,11 @@ def _ring_fwd_impl(q, k, v, axis_name, causal):
                 jnp.full((B * H, S), -jnp.inf, jnp.float32))
 
     def off_diag(kv):
-        o, lse = _flash_fwd(qr, kv[0], kv[1], False)
+        o, lse = _flash_fwd(qr, kv[0], kv[1], False, g=groups)
         return o.astype(jnp.float32), lse
 
     def diag(kv):
-        o, lse = _flash_fwd(qr, kv[0], kv[1], True)
+        o, lse = _flash_fwd(qr, kv[0], kv[1], True, g=groups)
         return o.astype(jnp.float32), lse
 
     def body(carry, _):
@@ -169,6 +180,7 @@ def _rf_b(axis_name, causal, res, g):
     sp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
+    groups = H // k.shape[2]
     qr, kr, vr, gr = _bhsd(q), _bhsd(k), _bhsd(v), _bhsd(g.astype(q.dtype))
     # global delta/lse lane-broadcasts depend only on (out, g): compute ONCE,
     # reuse on every ring hop
@@ -176,14 +188,15 @@ def _rf_b(axis_name, causal, res, g):
 
     def skip(kv):
         z = jnp.zeros(qr.shape, jnp.float32)
-        return z, z, z
+        zkv = jnp.zeros(kr.shape, jnp.float32)
+        return z, zkv, zkv
 
     def pair(kv, diag):
         # the kernels recompute p = exp(s - GLOBAL lse) with the global
         # delta, so each pair's tiled kernels emit exactly its
         # contribution to dq / dk / dv
         dq, dk, dv = _bwd_pallas_pre(qr, kv[0], kv[1], gr, lse_b, dta_b,
-                                     diag)
+                                     diag, groups=groups)
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),
                 dv.astype(jnp.float32))
 
@@ -211,11 +224,13 @@ def _rf_b(axis_name, causal, res, g):
                 (kv_rank - 1) % sp), None
 
     z = jnp.zeros(qr.shape, jnp.float32)
+    zkv = jnp.zeros(kr.shape, jnp.float32)
     (dq, _, _, dk, dv, _), _ = jax.lax.scan(
-        body, (z, kr, vr, z, z, idx), None, length=sp)
+        body, (z, kr, vr, zkv, zkv, idx), None, length=sp)
+    h_kv = k.shape[2]
     return (_unbhsd(dq.astype(q.dtype), B, H),
-            _unbhsd(dk.astype(k.dtype), B, H),
-            _unbhsd(dv.astype(v.dtype), B, H))
+            _unbhsd(dk.astype(k.dtype), B, h_kv),
+            _unbhsd(dv.astype(v.dtype), B, h_kv))
 
 
 ring_flash_attention.defvjp(_rf_f, _rf_b)
