@@ -206,27 +206,49 @@ def _child_decode():
     import jax.numpy as jnp
     from paddle_tpu.models import gpt
 
-    cfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_seq_len=1024, dtype='bfloat16',
-                        remat=False, use_flash=False)
+    if os.environ.get('BENCH_DECODE_TINY') == '1':
+        # off-chip validation of this child (incl. the int8 A/B) in seconds
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=64, dtype='bfloat16',
+                            remat=False, use_flash=False)
+        B, T0, N = 2, 8, 8
+    else:
+        cfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=1024,
+                            dtype='bfloat16', remat=False, use_flash=False)
+        B, T0, N = 8, 128, 64
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
     prefill, step = gpt.make_decode_fns(cfg)
-    B, T0, N = 8, 128, 64
     prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
                                 cfg.vocab_size)
-    cache = gpt.init_kv_cache(cfg, B)
-    logits, cache = prefill(params, prompt, cache)
-    tok = jnp.argmax(logits, -1).astype(jnp.int32)
-    # warm the step compile, then fence
-    logits, cache = step(params, tok, jnp.int32(T0), cache)
-    float(logits[0, 0])
-    t0 = time.perf_counter()
-    for i in range(1, N):
-        logits, cache = step(params, jnp.argmax(logits, -1).astype(jnp.int32),
-                             jnp.int32(T0 + i), cache)
-    float(logits[0, 0])                 # host read fences the chain
-    dt = time.perf_counter() - t0
-    print(json.dumps({'decode_tokens_per_sec': B * (N - 1) / dt}))
+    def run(p):
+        cache = gpt.init_kv_cache(cfg, B)
+        logits, cache = prefill(p, prompt, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        # warm the step compile, then fence
+        logits, cache = step(p, tok, jnp.int32(T0), cache)
+        float(logits[0, 0])
+        t0 = time.perf_counter()
+        for i in range(1, N):
+            logits, cache = step(p,
+                                 jnp.argmax(logits, -1).astype(jnp.int32),
+                                 jnp.int32(T0 + i), cache)
+        float(logits[0, 0])             # host read fences the chain
+        return B * (N - 1) / (time.perf_counter() - t0)
+
+    out = {'decode_tokens_per_sec': run(params)}
+    # weight-only int8 A/B: halved weight bytes on the HBM-bound step
+    # (ops/weight_only.py); same jitted fns — the pytree shape retraces
+    qparams = jax.tree_util.tree_map(jnp.asarray,
+                                     gpt.quantize_decode_params(params))
+    out['decode_int8_tokens_per_sec'] = run(qparams)
+    # + int8 KV cache (per-row scales; int8 flash decode kernel on TPU):
+    # at this config the cache is the bigger HBM stream than the weights
+    import dataclasses
+    cfg = dataclasses.replace(cfg, kv_cache_int8=True)
+    prefill, step = gpt.make_decode_fns(cfg)
+    out['decode_int8kv_tokens_per_sec'] = run(qparams)
+    print(json.dumps(out))
 
 
 def _child_predictor():
@@ -501,6 +523,10 @@ def main(fast=False):
         if dec is not None:
             out['decode_tokens_per_sec'] = round(
                 dec['decode_tokens_per_sec'], 1)
+            for k in ('decode_int8_tokens_per_sec',
+                      'decode_int8kv_tokens_per_sec'):
+                if k in dec:
+                    out[k] = round(dec[k], 1)
         else:
             print(f'decode bench failed: {dnote}', file=sys.stderr)
 

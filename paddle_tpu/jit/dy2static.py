@@ -14,11 +14,15 @@ conditions (→ jnp.logical_* when traced, exact short-circuit otherwise),
 and ``break``/``continue`` in loops (lowered to flag variables + guards by
 a pre-pass — the reference's break_continue_transformer.py — so a
 tensor-conditioned break becomes loop-carried lax state; a ``for range``
-containing break lowers to its while-form first), over bodies that only
-rebind local variables. Still-unsupported constructs (``return`` escaping
-a tensor branch/loop, attribute/subscript stores, a var bound in only one
-branch) raise Dy2StaticError with an actionable message instead of jax's
-TracerBoolConversionError.
+containing break lowers to its while-form first; break/continue inside
+``except`` handlers and loop-``else`` blocks are seen too), early
+``return`` in tensor branches (single-exit lowering: the statements after
+the if become the else-continuation — _ReturnLowering, the reference's
+return_transformer.py), and attribute/subscript stores via slot
+localization (``self.n = ...`` in a tensor branch/loop round-trips as a
+loop carrier). Still-unsupported constructs (``return`` inside a LOOP
+body, a var bound in only one branch) raise Dy2StaticError with an
+actionable message instead of jax's TracerBoolConversionError.
 """
 import ast
 import functools

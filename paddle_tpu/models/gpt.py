@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer, Parameter
+from ..ops.weight_only import wo_lm_head, wo_matmul, wo_take
 
 
 def validate_gqa(num_heads, num_kv_heads, mp):
@@ -81,6 +82,10 @@ class GPTConfig:
     # [B,S,V] f32 logits (ops/xent.py). Auto-falls back when the vocab
     # doesn't tile or under mp/sp/pp sharded losses.
     xent_chunk: int = 8192
+    # serving: store the KV cache as int8 with per-row scales — at long
+    # context the cache, not the weights, is the decode step's biggest HBM
+    # stream (ops/weight_only.quantize_kv; int8 flash decode kernel)
+    kv_cache_int8: bool = False
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
@@ -208,7 +213,7 @@ def _block_qkv(bp, y, nh, hd, cdt, kvh=None):
     B, S, _ = y.shape
     kvh = nh if kvh is None else kvh
     g = nh // kvh
-    qkv = y @ bp['qkv_w'].astype(cdt) + bp['qkv_b'].astype(cdt)
+    qkv = wo_matmul(y, bp['qkv_w'], cdt) + bp['qkv_b'].astype(cdt)
     qkv = qkv.reshape(B, S, kvh, g + 2, hd)
     q = qkv[..., :g, :].reshape(B, S, nh, hd)
     return q, qkv[..., g, :], qkv[..., g + 1, :]
@@ -217,8 +222,8 @@ def _block_qkv(bp, y, nh, hd, cdt, kvh=None):
 def _block_mlp(bp, y, cdt):
     """fc -> gelu -> out projection (bias added by the caller after the
     mp all-reduce)."""
-    y = jax.nn.gelu(y @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt))
-    return y @ bp['out_w'].astype(cdt)
+    y = jax.nn.gelu(wo_matmul(y, bp['fc_w'], cdt) + bp['fc_b'].astype(cdt))
+    return wo_matmul(y, bp['out_w'], cdt)
 
 
 def block_fn(bp, x, config, explicit_mp=False):
@@ -241,7 +246,7 @@ def block_fn(bp, x, config, explicit_mp=False):
         y = f_identity(y, 'mp')
     q, k, v = _block_qkv(bp, y, nh, hd, cdt, kvh)
     a = _attention(q, k, v, config).reshape(B, S, h // mp)
-    a = a @ bp['proj_w'].astype(cdt)
+    a = wo_matmul(a, bp['proj_w'], cdt)
     if mp > 1:
         a = g_allreduce(a, 'mp')
     x = x + a + bp['proj_b'].astype(cdt)
@@ -261,7 +266,7 @@ def forward_hidden(params, tokens, config: GPTConfig):
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     pos = jnp.arange(S)
-    x = jnp.take(params['wte'], tokens, axis=0) + params['wpe'][pos]
+    x = wo_take(params['wte'], tokens) + params['wpe'][pos]
     x = x.astype(cdt)
 
     body = partial(block_fn, config=config)
@@ -278,7 +283,7 @@ def forward_hidden(params, tokens, config: GPTConfig):
 def forward(params, tokens, config: GPTConfig):
     """tokens: [B, S] int32 -> logits [B, S, V]. lax.scan over stacked blocks."""
     x = forward_hidden(params, tokens, config)
-    return x @ params['wte'].T.astype(x.dtype)
+    return wo_lm_head(x, params['wte'], x.dtype)
 
 
 def loss_fn(params, tokens, targets, config: GPTConfig):
@@ -311,11 +316,34 @@ def loss_fn(params, tokens, targets, config: GPTConfig):
 # compiled step (no per-length retracing).
 # ---------------------------------------------------------------------------
 
+def quantize_decode_params(params):
+    """Weight-only int8 snapshot of a GPT param pytree for serving (see
+    ops/weight_only.py): the four block matrices and the tied embedding go
+    int8 with per-output-channel (per-vocab-row for ``wte``) f32 scales;
+    biases, norms and ``wpe`` stay as-is. The quantized pytree drops
+    straight into ``forward`` / ``forward_with_cache`` — every weight
+    consumer routes through the wo_* helpers — halving the HBM bytes the
+    bandwidth-bound decode step must stream per token."""
+    from ..ops.weight_only import quantize_weight
+    blocks = dict(params['blocks'])
+    for k in ('qkv_w', 'proj_w', 'fc_w', 'out_w'):
+        blocks[k] = quantize_weight(blocks[k], reduce_axis=1)
+    out = dict(params)
+    out['blocks'] = blocks
+    out['wte'] = quantize_weight(params['wte'], reduce_axis=1)
+    return out
+
+
 def init_kv_cache(config: GPTConfig, batch):
-    """-> {'k','v': [L, B, S_max, H, Dh] in the compute dtype}."""
+    """-> {'k','v': [L, B, S_max, H_kv, Dh] in the compute dtype}, or with
+    ``config.kv_cache_int8`` each of k/v is ``{'int8': that shape int8,
+    'scale': [L, B, S_max, H_kv] f32}`` (per-row quantization)."""
     cdt = jnp.dtype(config.dtype)
     shape = (config.num_layers, batch, config.max_seq_len,
              config.kv_heads, config.head_dim)
+    if config.kv_cache_int8:
+        from ..ops.weight_only import init_kv_bank
+        return {'k': init_kv_bank(shape), 'v': init_kv_bank(shape)}
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
@@ -323,26 +351,48 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
     """Shared KV-cache attention core (used by gpt AND moe_gpt decode):
     writes rows [pos, pos+T) into the caches, attends each q row to cache
     positions <= its absolute index, applies the output projection +
-    residual. Returns (x_new, k_cache, v_cache)."""
+    residual. Returns (x_new, k_cache, v_cache). Caches may be raw
+    ``[B, S_max, H_kv, D]`` arrays or int8 banks (init_kv_cache with
+    ``kv_cache_int8``): fresh rows quantize on write and attention runs
+    the int8 flash decode kernel (or a dequantizing fallback)."""
+    from ..ops.weight_only import dequantize_kv, is_weight_only, quantize_kv
     B, T, h = x.shape
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    int8_cache = is_weight_only(k_cache)
+    if int8_cache:
+        def write(bank, rows):
+            qr, sr = quantize_kv(rows)
+            return {'int8': jax.lax.dynamic_update_slice(
+                        bank['int8'], qr, (0, pos, 0, 0)),
+                    'scale': jax.lax.dynamic_update_slice(
+                        bank['scale'], sr.astype(bank['scale'].dtype),
+                        (0, pos, 0))}
+        k_cache, v_cache = write(k_cache, k), write(v_cache, v)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     from ..ops.flash_attention import (
         flash_attention, flash_attention_available, flash_decode,
-        flash_decode_available)
+        flash_decode_available, flash_decode_int8)
+    k_arr = k_cache['int8'] if int8_cache else k_cache
     if (isinstance(pos, int) and pos == 0
             and flash_attention_available(q, k, v, None)):
         # prefill at a STATIC position 0: attention over the cache equals
         # causal self-attention over the fresh k/v (later cache rows are
         # masked out anyway) — run the main flash kernel
         a = flash_attention(q, k, v, causal=True).reshape(B, T, h)
-    elif flash_decode_available(q, k_cache):
+    elif flash_decode_available(q, k_arr):
         # pallas decode kernel: streams only cache blocks up to ``pos``
-        a = flash_decode(q, k_cache, v_cache, pos).reshape(B, T, h)
+        a = (flash_decode_int8(q, k_cache, v_cache, pos) if int8_cache
+             else flash_decode(q, k_cache, v_cache, pos)).reshape(B, T, h)
     else:
         from ..ops.flash_attention import repeat_kv
-        k_cache_a, v_cache_a = repeat_kv(k_cache, v_cache, int(q.shape[2]))
-        S = k_cache.shape[1]
+        if int8_cache:
+            kc = dequantize_kv(k_cache['int8'], k_cache['scale'], cdt)
+            vc = dequantize_kv(v_cache['int8'], v_cache['scale'], cdt)
+        else:
+            kc, vc = k_cache, v_cache
+        k_cache_a, v_cache_a = repeat_kv(kc, vc, int(q.shape[2]))
+        S = k_arr.shape[1]
         scale = 1.0 / math.sqrt(q.shape[-1])
         s = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache_a) * scale  # [B,H,T,S]
         q_pos = pos + jnp.arange(T)[:, None]                    # [T,1]
@@ -351,7 +401,7 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
                       jnp.float32(-1e30))
         p = jax.nn.softmax(s, axis=-1).astype(cdt)
         a = jnp.einsum('bhqk,bkhd->bqhd', p, v_cache_a).reshape(B, T, h)
-    return (x + a @ proj_w.astype(cdt) + proj_b.astype(cdt),
+    return (x + wo_matmul(a, proj_w, cdt) + proj_b.astype(cdt),
             k_cache, v_cache)
 
 
@@ -380,7 +430,7 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)
-    x = (jnp.take(params['wte'], tokens, axis=0)
+    x = (wo_take(params['wte'], tokens)
          + jnp.take(params['wpe'], ppos, axis=0)).astype(cdt)
 
     def scan_body(carry, inp):
@@ -394,7 +444,7 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     if last_only:
         x = x[:, -1:]
     x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
-    logits = x @ params['wte'].T.astype(cdt)
+    logits = wo_lm_head(x, params['wte'], cdt)
     return logits, {'k': k_new, 'v': v_new}
 
 
@@ -687,7 +737,7 @@ class GPTForCausalLM(Layer):
         n_cached = (min(max_new_tokens, cfg.max_seq_len - T0 + 1)
                     if T0 < cfg.max_seq_len else 0)
         if n_cached > 0:
-            params = self._params()
+            params = self._decode_params()
             prefill, step = self._decode_fns()
             cache = init_kv_cache(cfg, B)
             logits, cache = prefill(params, toks, cache)
@@ -709,6 +759,24 @@ class GPTForCausalLM(Layer):
             self._decode_cache = make_decode_fns(self.config)
         return self._decode_cache
 
+    def enable_int8_decode(self, enable=True):
+        """Serve ``generate`` from weight-only int8 matrices (halved HBM
+        traffic on the bandwidth-bound decode path; ops/weight_only.py).
+        Quantization snapshots the CURRENT weights lazily at the next
+        ``generate``; call again after further training to re-snapshot.
+        Training and ``forward`` are untouched."""
+        self._int8_decode = enable
+        self._int8_params = None
+        return self
+
+    def _decode_params(self):
+        if not getattr(self, '_int8_decode', False):
+            return self._params()
+        if getattr(self, '_int8_params', None) is None:
+            self._int8_params = jax.tree_util.tree_map(
+                jnp.asarray, quantize_decode_params(self._params()))
+        return self._int8_params
+
     def _generate_sliding(self, toks, max_new_tokens, temperature, top_k):
         """Full-context recompute with a sliding window — the continuation
         once generation outgrows the KV cache (= max_seq_len). Every window
@@ -721,6 +789,6 @@ class GPTForCausalLM(Layer):
         fwd = self._sliding_fwd
         for _ in range(max_new_tokens):
             ctx = toks[:, -cfg.max_seq_len:]
-            nxt = _sample(fwd(self._params(), ctx), temperature, top_k)
+            nxt = _sample(fwd(self._decode_params(), ctx), temperature, top_k)
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         return Tensor(toks)

@@ -14,9 +14,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.weight_only import is_weight_only, wo_lm_head, wo_matmul, wo_take
 from ..parallel.moe import moe_ffn
 from .gpt import (_layer_norm, _attention, _block_qkv,
                   cached_attention, validate_gqa)
+
+
+def _c(w, cdt):
+    """Cast a raw weight to the compute dtype; weight-only int8 dicts pass
+    through (their consumers cast in the matmul epilogue)."""
+    return w if is_weight_only(w) else w.astype(cdt)
 
 
 @dataclasses.dataclass
@@ -41,6 +48,8 @@ class MoEConfig:
     pp: int = 1
     # blockwise LM-head cross-entropy chunk (0 disables) — see gpt.GPTConfig
     xent_chunk: int = 8192
+    # serving: int8 KV cache with per-row scales — see gpt.GPTConfig
+    kv_cache_int8: bool = False
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
@@ -106,10 +115,10 @@ def block_fn(bp, carry, config):
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
     a = _attention(q, k, v, config).reshape(B, S, h)
-    x = x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt)
+    x = x + wo_matmul(a, bp['proj_w'], cdt) + bp['proj_b'].astype(cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, aux = moe_ffn(y, bp['gate_w'].astype(cdt),
-                      bp['w_in'].astype(cdt), bp['w_out'].astype(cdt),
+                      _c(bp['w_in'], cdt), _c(bp['w_out'], cdt),
                       capacity_factor=config.capacity_factor)
     return (x + ff, aux_acc + aux), None
 
@@ -118,7 +127,7 @@ def forward_hidden(params, tokens, config):
     """-> (final hidden [B,S,H], aux load-balance loss)."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
-    x = (jnp.take(params['wte'], tokens, axis=0) +
+    x = (wo_take(params['wte'], tokens) +
          params['wpe'][jnp.arange(S)]).astype(cdt)
     body = partial(block_fn, config=config)
     if config.remat:
@@ -130,7 +139,7 @@ def forward_hidden(params, tokens, config):
 
 def forward(params, tokens, config):
     x, aux = forward_hidden(params, tokens, config)
-    return x @ params['wte'].T.astype(x.dtype), aux
+    return wo_lm_head(x, params['wte'], x.dtype), aux
 
 
 def loss_fn(params, tokens, targets, config):
@@ -162,10 +171,29 @@ def loss_fn(params, tokens, targets, config):
 # otherwise slightly BETTER-routed than training saw)
 # ---------------------------------------------------------------------------
 
+def quantize_decode_params(params):
+    """Weight-only int8 snapshot for serving (see gpt.quantize_decode_params
+    and ops/weight_only.py): attention matrices, the per-expert FFN banks —
+    the bulk of a MoE checkpoint — and the tied embedding go int8 with
+    per-output-channel scales. The returned pytree drops straight into
+    ``forward`` / ``generate``."""
+    from ..ops.weight_only import quantize_weight
+    blocks = dict(params['blocks'])
+    for k, ax in (('qkv_w', 1), ('proj_w', 1), ('w_in', 2), ('w_out', 2)):
+        blocks[k] = quantize_weight(blocks[k], reduce_axis=ax)
+    out = dict(params)
+    out['blocks'] = blocks
+    out['wte'] = quantize_weight(params['wte'], reduce_axis=1)
+    return out
+
+
 def init_kv_cache(config: 'MoEConfig', batch):
     cdt = jnp.dtype(config.dtype)
     shape = (config.num_layers, batch, config.max_seq_len,
              config.kv_heads, config.head_dim)
+    if config.kv_cache_int8:
+        from ..ops.weight_only import init_kv_bank
+        return {'k': init_kv_bank(shape), 'v': init_kv_bank(shape)}
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
@@ -178,8 +206,8 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config):
     x, k_cache, v_cache = cached_attention(
         x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
-    ff, _ = moe_ffn(y, bp['gate_w'].astype(cdt), bp['w_in'].astype(cdt),
-                    bp['w_out'].astype(cdt),
+    ff, _ = moe_ffn(y, bp['gate_w'].astype(cdt), _c(bp['w_in'], cdt),
+                    _c(bp['w_out'], cdt),
                     capacity_factor=config.capacity_factor)
     return x + ff, k_cache, v_cache
 
@@ -190,7 +218,7 @@ def forward_with_cache(params, tokens, cache, pos, config, last_only=False):
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)
-    x = (jnp.take(params['wte'], tokens, axis=0)
+    x = (wo_take(params['wte'], tokens)
          + jnp.take(params['wpe'], ppos, axis=0)).astype(cdt)
 
     def scan_body(carry, inp):
@@ -204,7 +232,7 @@ def forward_with_cache(params, tokens, cache, pos, config, last_only=False):
     if last_only:
         x = x[:, -1:]
     x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
-    return x @ params['wte'].T.astype(cdt), {'k': k_new, 'v': v_new}
+    return wo_lm_head(x, params['wte'], cdt), {'k': k_new, 'v': v_new}
 
 
 def make_decode_fns(config):

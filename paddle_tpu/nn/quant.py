@@ -126,6 +126,62 @@ class QuantizedConv2D(_QuantWrapperBase):
                         self.inner._data_format)
 
 
+class WeightOnlyLinear(Layer):
+    """Serving-time Linear whose weight is stored as int8 with one f32
+    scale per output channel (ops/weight_only.py). Dequantization folds
+    into the matmul epilogue — ``(x @ q) * s`` — so HBM streams half the
+    bytes of bf16; the bias (and gradients to ``x``) stay full precision.
+    The int8/scale pair are BUFFERS: they serialize through state_dict /
+    jit.save and are constants to the autograd tape."""
+
+    def __init__(self, layer):
+        super().__init__()
+        from ..core.tensor import Tensor
+        from ..ops.weight_only import quantize_weight
+        q = quantize_weight(layer.weight._value, reduce_axis=0)
+        self.register_buffer('weight_int8', Tensor(q['int8']))
+        self.register_buffer('weight_scale', Tensor(q['scale']))
+        self.bias = layer.bias
+        self.in_features = layer.in_features
+        self.out_features = layer.out_features
+
+    def forward(self, x):
+        def pure(xv, qv, sv, bv=None):
+            y = (xv @ qv.astype(xv.dtype)) * sv.astype(xv.dtype)
+            return y if bv is None else y + bv.astype(xv.dtype)
+        args = [x, self.weight_int8, self.weight_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply_op(pure, *args)
+
+    def extra_repr(self):
+        return (f'in_features={self.in_features}, '
+                f'out_features={self.out_features}, weight=int8')
+
+
+def weight_only_quantize(model, layer_types=(Linear,)):
+    """Swap Linear sublayers for ``WeightOnlyLinear`` in place
+    (serving-time weight-only int8 — the reference's inference int8
+    precision mode, paddle_analysis_config.h Precision::kInt8, redesigned
+    for the HBM-bound TPU decode path). ``layer_types`` narrows the swap
+    to given Linear subclasses. Returns the model; intended for
+    eval/serving — training through the quantized weights is not defined."""
+    bad = [t for t in layer_types if not issubclass(t, Linear)]
+    if bad:
+        raise TypeError(
+            f'weight_only_quantize: {[t.__name__ for t in bad]} are not '
+            'Linear subclasses — only Linear weights have the [in, out] '
+            'matmul layout this swap quantizes')
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, WeightOnlyLinear):
+            continue
+        if isinstance(sub, tuple(layer_types)):
+            model._sub_layers[name] = WeightOnlyLinear(sub)
+        else:
+            weight_only_quantize(sub, layer_types=layer_types)
+    return model
+
+
 def quantize_model(model, weight_bits=8, activation_bits=8,
                    layer_types=(Linear, Conv2D), **quant_kw):
     """Swap quantizable sublayers for QAT-wrapped versions in place.

@@ -653,6 +653,50 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale, bk, tq):
     o_ref[0] = (acc / jnp.maximum(l, _EPS)).astype(o_ref.dtype)
 
 
+def _decode_kernel_int8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                        *, scale, bk, tq):
+    """int8-KV-cache variant of ``_decode_kernel``: k/v blocks arrive as
+    int8 with per-row f32 scales ([1, 1, S] refs). The k scale is applied
+    to the SCORE columns after the q·k dot and the v scale folds into the
+    probability rows before the p·v dot — both cheaper than dequantizing
+    the blocks — so HBM and VMEM stream half the bf16 bytes."""
+    pos = pos_ref[0]
+    q = q_ref[0]                                       # [TQ_PAD, D] native
+    s_max = k_ref.shape[1]
+    nkb = s_max // bk
+    d = q.shape[-1]
+    n_iter = jnp.minimum(jnp.int32(nkb),
+                         (pos + jnp.int32(tq) + jnp.int32(bk - 1)) // bk)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        kblk = k_ref[0, pl.ds(kb * bk, bk), :].astype(q.dtype)
+        ksc = ks_ref[0, :, pl.ds(kb * bk, bk)]         # [1, bk] f32
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * _np.float32(scale)
+        s = s * ksc                                    # per-key dequant
+        q_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= pos + q_row, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        vblk = v_ref[0, pl.ds(kb * bk, bk), :].astype(q.dtype)
+        vsc = vs_ref[0, :, pl.ds(kb * bk, bk)]         # [1, bk] f32
+        acc = acc * alpha + jax.lax.dot_general(
+            (p * vsc).astype(q.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((q.shape[0], d), jnp.float32)
+    m0 = jnp.full((q.shape[0], 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), n_iter, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, _EPS)).astype(o_ref.dtype)
+
+
 def _decode_bk(s_max):
     return 256 if s_max % 256 == 0 else 128
 
@@ -702,5 +746,50 @@ def flash_decode(q, k_cache, v_cache, pos):
         out_shape=jax.ShapeDtypeStruct((bh, _TQ_DECODE, d), q.dtype),
         interpret=_INTERPRET,
     )(jnp.asarray(pos, jnp.int32).reshape(1), qt, kt, vt)
+    out = out[:, :t]
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_decode_int8(q, k_cache, v_cache, pos):
+    """``flash_decode`` over an int8 KV cache: q [B,T,H,D] native dtype;
+    caches are ``{'int8': [B,S_max,H_kv,D] int8, 'scale': [B,S_max,H_kv]
+    f32}`` (ops/weight_only.quantize_kv rows). Availability: gate with
+    ``flash_decode_available(q, k_cache['int8'])``. Inference only."""
+    b, t, h, d = q.shape
+    s_max = int(k_cache['int8'].shape[1])
+    h_kv = int(k_cache['int8'].shape[2])
+    g = h // h_kv
+    bh = b * h
+    bk = _decode_bk(s_max)
+    qt = q.transpose(0, 2, 1, 3).reshape(bh, t, d)
+    qt = _pad_seq(qt, _TQ_DECODE)
+
+    def flat_kv(c):
+        kt = c['int8'].transpose(0, 2, 1, 3).reshape(b * h_kv, s_max, d)
+        sc = c['scale'].astype(jnp.float32).transpose(0, 2, 1).reshape(
+            b * h_kv, 1, s_max)
+        return kt, sc
+
+    kt, ks = flat_kv(k_cache)
+    vt, vs = flat_kv(v_cache)
+    scale = 1.0 / math.sqrt(d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, _TQ_DECODE, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, s_max, d), lambda b, *_: (b // g, 0, 0)),
+            pl.BlockSpec((1, s_max, d), lambda b, *_: (b // g, 0, 0)),
+            pl.BlockSpec((1, 1, s_max), lambda b, *_: (b // g, 0, 0)),
+            pl.BlockSpec((1, 1, s_max), lambda b, *_: (b // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _TQ_DECODE, d), lambda b, *_: (b, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_int8, scale=scale, bk=bk, tq=t),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, _TQ_DECODE, d), q.dtype),
+        interpret=_INTERPRET,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), qt, kt, vt, ks, vs)
     out = out[:, :t]
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

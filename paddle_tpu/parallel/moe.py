@@ -50,6 +50,18 @@ def top2_gating(logits, capacity):
     return combine, dispatch, aux
 
 
+def _expert_mm(spec, a, w, cdt):
+    """Per-expert matmul where ``w`` is raw [E, in, out] or weight-only int8
+    ``{'int8': [E, in, out], 'scale': [E, out]}`` (see ops/weight_only.py) —
+    the per-(expert, out-channel) scale is applied as a matmul epilogue so
+    HBM streams the int8 bytes."""
+    from ..ops.weight_only import is_weight_only
+    if is_weight_only(w):
+        out = jnp.einsum(spec, a, w['int8'].astype(cdt))
+        return out * w['scale'][:, None, :].astype(cdt)
+    return jnp.einsum(spec, a, w)
+
+
 def moe_ffn(x, gate_w, w_in, w_out, capacity_factor=1.25, mesh_axes=True):
     """x: [B, S, H]; gate_w: [H, E]; w_in: [E, H, F]; w_out: [E, F, H].
     Returns (y, aux_loss). Under pjit, shard w_in/w_out with
@@ -64,9 +76,9 @@ def moe_ffn(x, gate_w, w_in, w_out, capacity_factor=1.25, mesh_axes=True):
     combine, dispatch, aux = top2_gating(logits, capacity)
     combine = combine.astype(x.dtype)
     expert_in = jnp.einsum('tec,th->ech', dispatch.astype(x.dtype), xt)
-    h = jnp.einsum('ech,ehf->ecf', expert_in, w_in)
+    h = _expert_mm('ech,ehf->ecf', expert_in, w_in, x.dtype)
     h = jax.nn.gelu(h)
-    expert_out = jnp.einsum('ecf,efh->ech', h, w_out)
+    expert_out = _expert_mm('ecf,efh->ech', h, w_out, x.dtype)
     y = jnp.einsum('tec,ech->th', combine, expert_out)
     return y.reshape(B, S, H), aux
 

@@ -16,7 +16,9 @@ from ..nn import quant as _q
 from ..nn.layer_base import Layer
 
 __all__ = ['ImperativeQuantAware', 'PostTrainingQuantization',
-           'quant_post_dynamic']
+           'quant_post_dynamic', 'weight_only_quantize', 'WeightOnlyLinear']
+
+from ..nn.quant import WeightOnlyLinear, weight_only_quantize  # noqa: E402
 
 
 class ImperativeQuantAware:

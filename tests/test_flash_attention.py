@@ -316,6 +316,88 @@ def test_flash_decode_multi_row():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_flash_decode_int8_parity():
+    """int8-KV decode kernel vs naive attention over the DEQUANTIZED cache
+    (the quantization error itself is covered in test_weight_only_int8):
+    the kernel's post-dot scale application must equal pre-dot dequant."""
+    from paddle_tpu.ops.weight_only import dequantize_kv, quantize_kv
+    B, S, H, D = 2, 256, 2, 64
+    kc = jax.random.normal(jax.random.PRNGKey(31), (B, S, H, D))
+    vc = jax.random.normal(jax.random.PRNGKey(32), (B, S, H, D))
+    q = jax.random.normal(jax.random.PRNGKey(33), (B, 1, H, D))
+    kq, ks = quantize_kv(kc)
+    vq, vs = quantize_kv(vc)
+    kbank = {'int8': kq, 'scale': ks}
+    vbank = {'int8': vq, 'scale': vs}
+    assert fa.flash_decode_available(q, kbank['int8'])
+    kf = dequantize_kv(kq, ks, jnp.float32)
+    vf = dequantize_kv(vq, vs, jnp.float32)
+
+    @jax.jit
+    def run(pos):
+        return fa.flash_decode_int8(q, kbank, vbank, pos)
+
+    for pos in [0, 5, 100, 255]:
+        got = run(jnp.int32(pos))
+        sc = jnp.einsum('bqhd,bkhd->bhqk', q, kf) / np.sqrt(D)
+        sc = jnp.where(jnp.arange(S)[None, None, None, :] <= pos, sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        want = jnp.einsum('bhqk,bkhd->bqhd', p, vf)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_decode_int8_gqa_multi_row():
+    """GQA (2 q heads share 1 kv head) + T>1 rows through the int8 kernel."""
+    from paddle_tpu.ops.weight_only import dequantize_kv, quantize_kv
+    B, S, Hkv, D, T = 1, 256, 1, 64, 4
+    kc = jax.random.normal(jax.random.PRNGKey(34), (B, S, Hkv, D))
+    vc = jax.random.normal(jax.random.PRNGKey(35), (B, S, Hkv, D))
+    q = jax.random.normal(jax.random.PRNGKey(36), (B, T, 2, D))
+    kq, ks = quantize_kv(kc)
+    vq, vs = quantize_kv(vc)
+    got = fa.flash_decode_int8(q, {'int8': kq, 'scale': ks},
+                               {'int8': vq, 'scale': vs}, jnp.int32(10))
+    kf = jnp.repeat(dequantize_kv(kq, ks, jnp.float32), 2, axis=2)
+    vf = jnp.repeat(dequantize_kv(vq, vs, jnp.float32), 2, axis=2)
+    sc = jnp.einsum('bqhd,bkhd->bhqk', q, kf) / np.sqrt(D)
+    valid = (jnp.arange(S)[None, :] <= 10 + jnp.arange(T)[:, None])
+    sc = jnp.where(valid[None, None], sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    want = jnp.einsum('bhqk,bkhd->bqhd', p, vf)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_gpt_int8_cache_decode_routes_through_kernel():
+    """With interpret on, a kv_cache_int8 GPT decode runs the int8 kernel
+    path end-to-end and stays close to the fp-cache decode."""
+    from paddle_tpu.models import gpt
+    kw = dict(vocab_size=128, hidden_size=128, num_layers=2, num_heads=2,
+              max_seq_len=256, dtype='float32', remat=False, use_flash=False)
+    cfg_fp = gpt.GPTConfig(**kw)
+    cfg_q = gpt.GPTConfig(kv_cache_int8=True, **kw)
+    params = gpt.init_params(cfg_fp, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 128)
+
+    def decode(cfg):
+        prefill, step = gpt.make_decode_fns(cfg)
+        cache = gpt.init_kv_cache(cfg, 1)
+        logits, cache = prefill(params, prompt, cache)
+        toks = [int(jnp.argmax(logits, -1)[0])]
+        for i in range(4):
+            logits, cache = step(params, jnp.argmax(logits, -1).astype(jnp.int32),
+                                 jnp.int32(8 + i), cache)
+            toks.append(int(jnp.argmax(logits, -1)[0]))
+        return toks, np.asarray(logits)
+
+    toks_fp, lg_fp = decode(cfg_fp)
+    toks_q, lg_q = decode(cfg_q)
+    assert toks_q == toks_fp          # greedy agrees on this seed
+    cos = (lg_fp * lg_q).sum() / (np.linalg.norm(lg_fp) * np.linalg.norm(lg_q))
+    assert cos > 0.999, cos
+
+
 def test_gpt_decode_routes_through_flash_kernels():
     """With interpret on, gpt's KV-cache decode (prefill + per-token steps)
     runs the pallas kernels and matches the einsum path numerically."""
