@@ -34,6 +34,10 @@ def main():
     p.add_argument('--batch', type=int, default=1)
     p.add_argument('--hidden', type=int, default=256)
     p.add_argument('--layers', type=int, default=4)
+    p.add_argument('--int8', action='store_true',
+                   help='weight-only int8 decode (halved weight HBM bytes)')
+    p.add_argument('--int8-kv', action='store_true',
+                   help='int8 KV cache (per-row scales; int8 decode kernel)')
     args = p.parse_args()
     apply_platform(args)
     if args.hidden < 64 or args.hidden % 64:
@@ -41,11 +45,14 @@ def main():
 
     cfg = GPTConfig(vocab_size=32768, hidden_size=args.hidden,
                     num_layers=args.layers, num_heads=args.hidden // 64,
-                    max_seq_len=1024, dtype='bfloat16', remat=False)
+                    max_seq_len=1024, dtype='bfloat16', remat=False,
+                    kv_cache_int8=args.int8_kv)
     model = GPTForCausalLM(cfg)
     if args.ckpt:
         model.set_state_dict(paddle.load(args.ckpt))
     model.eval()
+    if args.int8:
+        model.enable_int8_decode()   # weight snapshot quantizes lazily
 
     prompt = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size,

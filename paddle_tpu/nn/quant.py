@@ -159,24 +159,70 @@ class WeightOnlyLinear(Layer):
                 f'out_features={self.out_features}, weight=int8')
 
 
-def weight_only_quantize(model, layer_types=(Linear,)):
-    """Swap Linear sublayers for ``WeightOnlyLinear`` in place
-    (serving-time weight-only int8 — the reference's inference int8
-    precision mode, paddle_analysis_config.h Precision::kInt8, redesigned
-    for the HBM-bound TPU decode path). ``layer_types`` narrows the swap
-    to given Linear subclasses. Returns the model; intended for
+class WeightOnlyConv2D(Layer):
+    """Serving-time Conv2D with an int8 weight bank and per-OUTPUT-CHANNEL
+    f32 scales (amax over in/kh/kw): the scale multiplies the conv output
+    channel — the same epilogue position as the bias — so XLA streams int8
+    weight bytes and fuses the dequant. Eval/serving only."""
+
+    def __init__(self, layer):
+        super().__init__()
+        from ..core.tensor import Tensor
+        from ..ops.weight_only import quantize_weight
+        q = quantize_weight(layer.weight._value, reduce_axis=(1, 2, 3))
+        self.register_buffer('weight_int8', Tensor(q['int8']))
+        self.register_buffer('weight_scale', Tensor(q['scale']))
+        self.bias = layer.bias
+        for a in ('_stride', '_padding', '_dilation', '_groups',
+                  '_data_format'):
+            setattr(self, a, getattr(layer, a))
+
+    def forward(self, x):
+        from .functional.conv import _conv
+        st, pd, dl, gp, df = (self._stride, self._padding, self._dilation,
+                              self._groups, self._data_format)
+        channels_last = df.endswith('C')    # 'NHWC'; 'NCHW' ends with 'W'
+
+        def pure(xv, qv, sv, bv=None):
+            y = _conv(xv, qv.astype(xv.dtype), None, st, pd, dl, gp, df, 2)
+            shape = ((1,) * (y.ndim - 1) + (-1,) if channels_last
+                     else (1, -1) + (1,) * (y.ndim - 2))
+            y = y * jnp.reshape(sv, shape).astype(y.dtype)
+            if bv is not None:
+                y = y + jnp.reshape(bv, shape).astype(y.dtype)
+            return y
+        args = [x, self.weight_int8, self.weight_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply_op(pure, *args)
+
+
+_WO_WRAPPERS = ((Linear, WeightOnlyLinear), (Conv2D, WeightOnlyConv2D))
+
+
+def weight_only_quantize(model, layer_types=(Linear, Conv2D)):
+    """Swap Linear/Conv2D sublayers for their weight-only int8 forms in
+    place (serving-time int8 — the reference's inference int8 precision
+    mode, paddle_analysis_config.h Precision::kInt8, redesigned for the
+    HBM-bound TPU serving path). ``layer_types`` narrows the swap to
+    subclasses of Linear / Conv2D. Returns the model; intended for
     eval/serving — training through the quantized weights is not defined."""
-    bad = [t for t in layer_types if not issubclass(t, Linear)]
+    bad = [t for t in layer_types
+           if not issubclass(t, tuple(b for b, _ in _WO_WRAPPERS))]
     if bad:
         raise TypeError(
             f'weight_only_quantize: {[t.__name__ for t in bad]} are not '
-            'Linear subclasses — only Linear weights have the [in, out] '
-            'matmul layout this swap quantizes')
+            'Linear/Conv2D subclasses — only those weight layouts have a '
+            'weight-only int8 form here')
+    types = tuple(layer_types)
     for name, sub in list(model._sub_layers.items()):
-        if isinstance(sub, WeightOnlyLinear):
+        if isinstance(sub, (WeightOnlyLinear, WeightOnlyConv2D)):
             continue
-        if isinstance(sub, tuple(layer_types)):
-            model._sub_layers[name] = WeightOnlyLinear(sub)
+        if isinstance(sub, types):
+            for base, wrapper in _WO_WRAPPERS:
+                if isinstance(sub, base):
+                    model._sub_layers[name] = wrapper(sub)
+                    break
         else:
             weight_only_quantize(sub, layer_types=layer_types)
     return model

@@ -16,9 +16,11 @@ from ..nn import quant as _q
 from ..nn.layer_base import Layer
 
 __all__ = ['ImperativeQuantAware', 'PostTrainingQuantization',
-           'quant_post_dynamic', 'weight_only_quantize', 'WeightOnlyLinear']
+           'quant_post_dynamic', 'weight_only_quantize', 'WeightOnlyLinear',
+           'WeightOnlyConv2D']
 
-from ..nn.quant import WeightOnlyLinear, weight_only_quantize  # noqa: E402
+from ..nn.quant import (WeightOnlyConv2D, WeightOnlyLinear,  # noqa: E402
+                        weight_only_quantize)
 
 
 class ImperativeQuantAware:

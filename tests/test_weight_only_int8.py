@@ -238,3 +238,42 @@ def test_weight_only_linear_layer_swap():
     # double application is a no-op (idempotent swap)
     weight_only_quantize(net)
     np.testing.assert_allclose(np.asarray(net(x)._value), out)
+    # non-quantizable types are rejected loudly
+    with pytest.raises(TypeError):
+        weight_only_quantize(net, layer_types=(paddle.nn.ReLU,))
+
+
+def test_weight_only_conv_lenet_predictor():
+    """Vision serving: LeNet with int8 convs AND linears through forward +
+    the standalone Predictor; Conv2DTranspose is NOT swapped (different
+    weight layout)."""
+    import os
+    import tempfile
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.quant import WeightOnlyConv2D, WeightOnlyLinear
+    from paddle_tpu.quantization import weight_only_quantize
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet()
+    net.eval()
+    x = np.random.default_rng(3).normal(size=(2, 1, 28, 28)).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x))._value)
+    weight_only_quantize(net)
+    kinds = [type(l).__name__ for l in net.sublayers()]
+    assert 'WeightOnlyConv2D' in kinds and 'WeightOnlyLinear' in kinds
+    assert 'Conv2D' not in kinds and 'Linear' not in kinds
+    out = np.asarray(net(paddle.to_tensor(x))._value)
+    assert np.abs(out - ref).max() < 0.05 * (np.abs(ref).max() + 1e-6)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'lenet8')
+        paddle.jit.save(net, path, input_spec=[
+            paddle.static.InputSpec([None, 1, 28, 28], 'float32')])
+        from paddle_tpu.inference import Config, create_predictor
+        (served,) = create_predictor(Config(path + '.pdmodel')).run([x])
+        np.testing.assert_allclose(served, out, rtol=1e-4, atol=1e-5)
+
+    # transpose convs keep their own class (layout not quantized here)
+    tnet = paddle.nn.Conv2DTranspose(3, 4, 3)
+    holder = paddle.nn.Sequential(tnet)
+    weight_only_quantize(holder)
+    assert type(holder[0]).__name__ == 'Conv2DTranspose'
