@@ -16,6 +16,9 @@ where GSPMD will have to reshard.
     plan.conflicts        # where specs disagreed (reshard points)
     step = plan.apply(fn, mesh)           # jit with planned in_shardings
     args = plan.place(example_args, mesh) # device_put by planned specs
+    fn2 = plan.constrain(mesh)            # reshard INSERTION: re-emits the
+    #                       program with with_sharding_constraint pinned at
+    #                       every conflict-resolved value (reshard.py's role)
 
 Propagation rules cover the primitive vocabulary of the model zoo (matmul
 family, elementwise, reductions, reshape/transpose/broadcast, gather,
@@ -50,10 +53,14 @@ def _aval_shape(atom):
 class _Env:
     """var -> dim-spec tuple (axis-name | None per dim). Tracks change."""
 
-    def __init__(self, conflicts):
+    def __init__(self, conflicts, conflict_vars=None):
         self.specs = {}
         self.changed = False
         self.conflicts = conflicts
+        # vars whose spec was RESOLVED against a competing demand — the
+        # reshard points plan.constrain pins with_sharding_constraint at
+        self.conflict_vars = conflict_vars if conflict_vars is not None \
+            else set()
 
     def get(self, atom):
         if isinstance(atom, Literal):
@@ -72,7 +79,7 @@ class _Env:
             return
         old = self.specs.get(var)
         if old is None:
-            self.specs[var] = self._dedup(spec, where)
+            self.specs[var] = self._dedup(spec, where, var)
             self.changed = True
             return
         merged = []
@@ -85,13 +92,14 @@ class _Env:
                 self.conflicts.append(
                     f'{where}: dim wants both {a!r} and {b!r} — keeping '
                     f'{a!r} (GSPMD reshards here)')
+                self.conflict_vars.add(var)
                 merged.append(a)
-        merged = self._dedup(tuple(merged), where)
+        merged = self._dedup(tuple(merged), where, var)
         if merged != old:
             self.specs[var] = merged
             self.changed = True
 
-    def _dedup(self, spec, where):
+    def _dedup(self, spec, where, var=None):
         """A mesh axis may shard at most one dim; keep the first."""
         seen, out = set(), []
         for a in spec:
@@ -99,6 +107,8 @@ class _Env:
                 self.conflicts.append(
                     f'{where}: axis {a!r} appears on multiple dims — '
                     'dropping the later one')
+                if var is not None:
+                    self.conflict_vars.add(var)
                 out.append(None)
             else:
                 out.append(a)
@@ -494,10 +504,15 @@ class _Planner:
 
 
 class ShardingPlan:
-    def __init__(self, arg_specs, out_specs, conflicts):
+    def __init__(self, arg_specs, out_specs, conflicts, closed=None,
+                 treedef=None, out_treedef=None, conflict_specs=None):
         self.arg_specs = arg_specs
         self.out_specs = out_specs
         self.conflicts = conflicts
+        self._closed = closed               # traced program (for constrain)
+        self._treedef = treedef
+        self._out_treedef = out_treedef
+        self._conflict_specs = conflict_specs or {}
 
     def placements(self, mesh):
         return jax.tree_util.tree_map(
@@ -513,6 +528,55 @@ class ShardingPlan:
         return jax.jit(fn, in_shardings=jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(self.arg_specs), flat_sh))
 
+    def constrain(self, mesh):
+        """Explicit reshard insertion (reference: auto_parallel/reshard.py —
+        there it splices send/recv ops at dist_attr mismatches; here the
+        TPU-native form pins ``lax.with_sharding_constraint`` at every
+        value whose spec the completion pass had to RESOLVE against a
+        competing demand, so GSPMD reshards exactly where the planner
+        decided instead of where its own cost model guesses).
+
+        Returns a callable with the original function's signature that
+        re-executes the traced program with the constraints inserted —
+        jit it (or pass it to ``apply``) to compile. Conflicts inside
+        sub-programs (scan bodies) are reported but not pinned."""
+        jaxpr = self._closed.jaxpr
+        consts = self._closed.consts
+        cmap = {v: NamedSharding(mesh, PartitionSpec(*s))
+                for v, s in self._conflict_specs.items()}
+        treedef, out_treedef = self._treedef, self._out_treedef
+
+        def run(*args):
+            flat = treedef.flatten_up_to(args)
+            if len(flat) != len(jaxpr.invars):
+                raise TypeError(
+                    f'plan.constrain: got {len(flat)} argument leaves, the '
+                    f'traced program takes {len(jaxpr.invars)}')
+            env = {}
+            for v, c in zip(jaxpr.constvars, consts):
+                env[v] = c
+            for v, a in zip(jaxpr.invars, flat):
+                sh = cmap.get(v)
+                env[v] = (jax.lax.with_sharding_constraint(a, sh)
+                          if sh is not None else a)
+
+            def read(a):
+                return a.val if isinstance(a, Literal) else env[a]
+
+            for eqn in jaxpr.eqns:
+                out = eqn.primitive.bind(*[read(a) for a in eqn.invars],
+                                         **eqn.params)
+                if not eqn.primitive.multiple_results:
+                    out = [out]
+                for ov, val in zip(eqn.outvars, out):
+                    sh = cmap.get(ov)
+                    if sh is not None:
+                        val = jax.lax.with_sharding_constraint(val, sh)
+                    env[ov] = val
+            outs = [read(v) for v in jaxpr.outvars]
+            return jax.tree_util.tree_unflatten(out_treedef, outs)
+        return run
+
 
 def complete_shardings(fn, example_args, seeds, n_iter=8):
     """Run the completion pass.
@@ -524,9 +588,12 @@ def complete_shardings(fn, example_args, seeds, n_iter=8):
     """
     flat_args, treedef = jax.tree_util.tree_flatten(example_args)
     flat_seeds = treedef.flatten_up_to(seeds)
+    out_store = {}
 
     def flat_fn(*leaves):
-        return fn(*jax.tree_util.tree_unflatten(treedef, leaves))
+        out = fn(*jax.tree_util.tree_unflatten(treedef, leaves))
+        flat_out, out_store['td'] = jax.tree_util.tree_flatten(out)
+        return flat_out
 
     closed = jax.make_jaxpr(flat_fn)(*flat_args)
     jaxpr = closed.jaxpr
@@ -551,4 +618,9 @@ def complete_shardings(fn, example_args, seeds, n_iter=8):
     arg_specs = jax.tree_util.tree_unflatten(
         treedef, [to_pspec(v) for v in jaxpr.invars])
     out_specs = [to_pspec(v) for v in jaxpr.outvars]
-    return ShardingPlan(arg_specs, out_specs, conflicts)
+    out_treedef = out_store['td']      # captured during the single trace
+    conflict_specs = {v: env.specs[v] for v in env.conflict_vars
+                      if v in env.specs}
+    return ShardingPlan(arg_specs, out_specs, conflicts, closed=closed,
+                        treedef=treedef, out_treedef=out_treedef,
+                        conflict_specs=conflict_specs)
