@@ -41,6 +41,7 @@ __all__ = ['convert_control_flow', 'Dy2StaticError']
 
 _RT_NAME = '_pt_dy2st'          # name the runtime is injected under
 _GEN_PREFIX = '_pt_'            # prefix of every generated symbol
+_ATTR_PREFIX = f'{_GEN_PREFIX}attr'   # localized attribute/subscript slots
 
 
 class Dy2StaticError(Exception):
@@ -89,8 +90,18 @@ def _check_bound(names, values, stmt):
                 f"both paths produce the same variables.")
 
 
-def convert_ifelse(pred, true_fn, false_fn, names, init_vals):
-    """if/else on ``pred``: lax.cond when traced, plain Python otherwise."""
+def convert_ifelse(pred, true_fn, false_fn, names, init_vals,
+                   out_names=None):
+    """if/else on ``pred``: lax.cond when traced, plain Python otherwise.
+
+    ``names``/``init_vals``: the branch fns' parameter vars (inputs — every
+    local either branch reads or rebinds, so outer values flow in even when
+    the branch's own rebinding would shadow them). ``out_names``: the
+    subset the branches RETURN (default: all) — a return-lowered terminal
+    if passes the full modified set in but only the result carrier out,
+    since nothing else is live after it."""
+    if out_names is None:
+        out_names = names
     if not _is_traced(pred):
         return true_fn(*init_vals) if _to_py_bool(pred) else \
             false_fn(*init_vals)
@@ -107,7 +118,7 @@ def convert_ifelse(pred, true_fn, false_fn, names, init_vals):
                 full[i] = (Tensor(u_vals[j])
                            if isinstance(init_vals[i], Tensor) else u_vals[j])
             outs = fn(*full)
-            _check_bound(names, outs, 'if/else')
+            _check_bound(out_names, outs, 'if/else')
             return tuple(_unwrap(o) for o in outs)
         return run
 
@@ -117,7 +128,7 @@ def convert_ifelse(pred, true_fn, false_fn, names, init_vals):
     except TypeError as e:
         raise Dy2StaticError(
             f'the two branches of a tensor-dependent if/else must produce '
-            f'matching shapes/dtypes for {names}; ({e})') from e
+            f'matching shapes/dtypes for {out_names}; ({e})') from e
     return tuple(Tensor(o) if isinstance(o, (jax.Array, jax.core.Tracer))
                  else o for o in outs)
 
@@ -366,7 +377,7 @@ def _mods_of(*stmt_lists):
     # while-form loop index, and the return-lowering result carrier —
     # those are genuine branch/loop-carried state
     keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx',
-            f'{_GEN_PREFIX}rv', f'{_GEN_PREFIX}attr')
+            f'{_GEN_PREFIX}rv', _ATTR_PREFIX)
     return sorted(n for n in names
                   if not n.startswith(_GEN_PREFIX) or n.startswith(keep))
 
@@ -796,7 +807,7 @@ class _ComplexStoreLowering(ast.NodeTransformer):
 
     def _gen(self):
         self._uid += 1
-        return f'{_GEN_PREFIX}attr{self._uid}'
+        return f'{_ATTR_PREFIX}{self._uid}'
 
     # ---- collection ------------------------------------------------------
     @staticmethod
@@ -934,10 +945,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         node.test = _rewrite_boolops(node.test)
         mods = _mods_of(node.body, node.orelse)
+        out_mods = None
         if mods and getattr(node, '_pt_return_exit', False):
-            # return-lowered terminal if: only the result carrier is live
-            # after it; branch-local temps stay local to the branch fns
-            mods = [_ReturnLowering.RV]
+            # return-lowered terminal if: live after it are only the result
+            # carrier and the localized attribute/subscript-slot temps
+            # (their function-end write-back is a real side effect), so
+            # only those are RETURNED/matched across branches — but the
+            # full modified set still flows IN as branch-fn params (a
+            # branch that reads x then rebinds it would otherwise shadow
+            # the outer x into an unbound local)
+            out_mods = ([_ReturnLowering.RV]
+                        + [m for m in mods if m.startswith(_ATTR_PREFIX)])
         if mods is None or not mods:
             # not convertible (or pure side-effect): keep Python `if`, but
             # make a traced condition fail with a clear message
@@ -948,22 +966,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                  [node.test, ast.Constant(value=reason)])
             return node
         uid = self._next()
+        rets = out_mods or mods
         tname, fname = f'{_GEN_PREFIX}t_{uid}', f'{_GEN_PREFIX}f_{uid}'
         sent, tmp_names = _sentinel_reads(mods, uid)
         call = ast.Assign(
-            targets=[ast.Tuple(elts=[_store(m) for m in mods],
+            targets=[ast.Tuple(elts=[_store(m) for m in rets],
                                ctx=ast.Store())],
             value=_rt_call('convert_ifelse', [
                 _load(f'{_GEN_PREFIX}c_{uid}'), _load(tname), _load(fname),
                 _names_tuple(mods),
                 ast.Tuple(elts=[_load(t) for t in tmp_names],
-                          ctx=ast.Load())]))
+                          ctx=ast.Load()),
+                _names_tuple(rets)]))
         return [
             ast.Assign(targets=[_store(f'{_GEN_PREFIX}c_{uid}')],
                        value=node.test),
-            _func_def(tname, mods, node.body, mods),
-            _func_def(fname, mods, node.orelse or [ast.Pass()], mods),
-            *sent, call, *_undef_dels(mods),
+            _func_def(tname, mods, node.body, rets),
+            _func_def(fname, mods, node.orelse or [ast.Pass()], rets),
+            *sent, call, *_undef_dels(rets),
         ]
 
     # -- while -----------------------------------------------------------

@@ -216,7 +216,10 @@ def weight_only_quantize(model, layer_types=(Linear, Conv2D)):
             'weight-only int8 form here')
     types = tuple(layer_types)
     for name, sub in list(model._sub_layers.items()):
-        if isinstance(sub, (WeightOnlyLinear, WeightOnlyConv2D)):
+        if isinstance(sub, (WeightOnlyLinear, WeightOnlyConv2D,
+                            _QuantWrapperBase)):
+            # QAT/PTQ wrappers already model int8 numerics (and their inner
+            # layer's weight must stay live for the fake-quant forward)
             continue
         if isinstance(sub, types):
             for base, wrapper in _WO_WRAPPERS:

@@ -787,6 +787,64 @@ def test_subscript_store_in_tensor_branch_eager():
     assert float(d2['k']) == 2.0
 
 
+def test_early_return_then_loop_in_continuation():
+    """Regression (round-4 journey audit): an early return whose else-
+    continuation contains a while loop — the return-exit if must pass the
+    full modified set INTO the branch fns (x is read then rebound by the
+    loop; narrowing the params to the carrier made outer x an unbound
+    local that leaked UNDEF into the loop body)."""
+    def f(x):
+        s = x.sum()
+        if s > 100.0:
+            return x * 0.0
+        i = 0
+        while i < 3:
+            x = x * 2.0
+            i += 1
+        return x
+
+    g = convert_control_flow(f)
+    # traced condition end-to-end under jit
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    def pure(xv):
+        return g(Tensor(xv))._value
+
+    out = jax.jit(pure)(jnp.ones((2, 2)))
+    np.testing.assert_allclose(np.asarray(out), 8.0 * np.ones((2, 2)))
+    big = jax.jit(pure)(jnp.full((2, 2), 100.0))
+    np.testing.assert_allclose(np.asarray(big), 0.0)
+
+
+def test_early_return_preserves_attribute_store_side_effect():
+    """Regression (round-4 journey audit): a buffer store in the else-
+    continuation of a lowered early return must survive — the slot temps
+    are side-effect carriers and belong to the return-exit if's OUT set
+    (they were silently dropped when only the carrier was returned)."""
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer('calls',
+                                 paddle.to_tensor(np.float32(0.0)))
+
+        def forward(self, x):
+            s = x.sum()
+            if s > 100.0:
+                return x * 0.0
+            self.calls = self.calls + 1.0
+            return x * 2.0
+
+    net = Gate()
+    st = paddle.jit.to_static(net)
+    st(_t(np.ones((2, 2), np.float32)))
+    st(_t(np.ones((2, 2), np.float32)))
+    assert float(net.calls) == 2.0
+    st(_t(np.full((2, 2), 100.0, np.float32)))   # early-return path
+    assert float(net.calls) == 2.0               # not incremented
+
+
 def test_attribute_store_python_cond_semantics_unchanged():
     class Box:
         pass

@@ -243,6 +243,50 @@ def test_weight_only_linear_layer_swap():
         weight_only_quantize(net, layer_types=(paddle.nn.ReLU,))
 
 
+def test_weight_only_skips_qat_wrappers():
+    """weight_only_quantize must not gut a QAT/PTQ-wrapped layer (its inner
+    Linear weight stays live for the fake-quant forward)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import (ImperativeQuantAware,
+                                         weight_only_quantize)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    ImperativeQuantAware().quantize(net)
+    qat_type = type(net.fc).__name__
+    weight_only_quantize(net)
+    assert type(net.fc).__name__ == qat_type   # untouched
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    net.eval()
+    assert np.isfinite(np.asarray(net(x)._value)).all()
+
+
+def test_moe_int8_kv_generate():
+    """MoE decode with the int8 KV cache config (shared cached_attention
+    core) stays on the fp-cache trajectory."""
+    from paddle_tpu.models import moe_gpt
+    kw = dict(vocab_size=61, hidden_size=32, num_layers=2, num_heads=4,
+              n_experts=4, max_seq_len=32, dtype='float32', use_flash=False,
+              remat=False, capacity_factor=4.0, xent_chunk=0)
+    cfg = moe_gpt.MoEConfig(**kw)
+    cfg_q = moe_gpt.MoEConfig(kv_cache_int8=True, **kw)
+    params = moe_gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    fp_t = moe_gpt.generate(params, cfg, prompt, 5)
+    q_t = moe_gpt.generate(params, cfg_q, prompt, 5)
+    fp = np.asarray(getattr(fp_t, '_value', fp_t))
+    q8 = np.asarray(getattr(q_t, '_value', q_t))
+    assert q8.shape == fp.shape
+    assert (q8 == fp).mean() >= 0.7
+
+
 def test_weight_only_conv_lenet_predictor():
     """Vision serving: LeNet with int8 convs AND linears through forward +
     the standalone Predictor; Conv2DTranspose is NOT swapped (different
