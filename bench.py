@@ -124,6 +124,7 @@ def _child_train(cfg):
                          num_layers=cfg['layers'], num_heads=cfg['heads'],
                          max_seq_len=seq, dtype='bfloat16',
                          remat=cfg.get('remat', True),
+                         remat_policy=cfg.get('remat_policy', 'dots'),
                          use_flash=cfg.get('use_flash', True),
                          xent_chunk=cfg.get('xent_chunk', 8192))
     params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
@@ -415,29 +416,35 @@ def main(fast=False):
     # (pure XLA attention) -> small model. A kernel regression on the real
     # chip can cost perf but never the round's measurement.
     configs = [
-        # remat off first: at 350M the activations fit HBM comfortably and
-        # skipping the backward recompute is strictly faster; an OOM only
-        # costs this one bounded subprocess before the remat variants
-        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
-             vocab=32768, iters=20, remat=False),
+        # Rung 1 is the r4 on-chip-tuned configuration (tools/tpu_tune.py):
+        # 'dots' selective remat + auto-picked 512-row flash blocks —
+        # measured 35.2k tok/s / 36.1% MFU on v5e. remat=False is NOT a
+        # rung: measured HBM OOM at this size (scan carries
+        # bf16[24,8,1024,1024] temps).
         dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
              vocab=32768, iters=20),
+        # full-recompute fallback in case 'dots' regresses into OOM
+        dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
+             vocab=32768, iters=20, remat_policy='full'),
         dict(batch=4, seq=1024, hidden=1024, layers=24, heads=16,
-             vocab=32768, iters=20),
+             vocab=32768, iters=20, remat_policy='full'),
         dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
-             vocab=32768, iters=20, flash_jnp_bwd=True),
+             vocab=32768, iters=20, flash_jnp_bwd=True,
+             remat_policy='full'),
         dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
-             vocab=32768, iters=20, use_flash=False),
+             vocab=32768, iters=20, use_flash=False, remat_policy='full'),
         dict(batch=4, seq=512, hidden=768, layers=12, heads=12,
-             vocab=32768, iters=10, use_flash=False),
+             vocab=32768, iters=10, use_flash=False, remat_policy='full'),
     ]
     if fast:
-        # Two rungs only: the full config and one kernel-regression fallback.
+        # Two rungs only: the tuned config and one kernel-regression
+        # fallback.
         configs = [
             dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
-                 vocab=32768, iters=8, remat=False),
+                 vocab=32768, iters=8),
             dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
-                 vocab=32768, iters=8, use_flash=False, remat=False),
+                 vocab=32768, iters=8, use_flash=False,
+                 remat_policy='full'),
         ]
         out['profile'] = 'fast'
     if platform == 'cpu':  # keep the smoke path fast off-TPU, and never

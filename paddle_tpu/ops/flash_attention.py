@@ -54,8 +54,30 @@ def _env_block(name, default):
     return v if v > 0 and v % 128 == 0 else default
 
 
-_BQ = _env_block('PADDLE_TPU_FLASH_BQ', 256)   # q-block rows
-_BK = _env_block('PADDLE_TPU_FLASH_BK', 256)   # k/v-block rows
+_BQ_CAP = _env_block('PADDLE_TPU_FLASH_BQ', 512)   # q-block row cap
+_BK_CAP = _env_block('PADDLE_TPU_FLASH_BK', 512)   # k/v-block row cap
+
+
+def _pick_block(s, cap):
+    """Largest block ≤ cap dividing the 128-padded seq length. 512 is the
+    measured v5e sweet spot (tools/tpu_tune.py r4: 512/512 beats 256/256 by
+    ~13% on the 350M bench config); shorter/ragged seqs fall back to the
+    largest divisor so padding stays at 128-row granularity."""
+    sp = -(-s // 128) * 128
+    for b in (cap, 512, 256, 128):
+        if 0 < b <= cap and sp % b == 0:
+            return b
+    return 128
+
+
+def _pick_blocks(s_q, s_k):
+    bq = _pick_block(s_q, _BQ_CAP)
+    bk = min(_pick_block(s_k, _BK_CAP), bq)
+    # kernels require bk | bq; non-power-of-two env caps can break it, so
+    # halve (floored at the 128 tiling minimum, which divides any pick)
+    while bq % bk and bk > 128:
+        bk = max(128, bk // 2)
+    return bq, bk
 _LANES = 128   # TPU lane width; lse is stored lane-broadcast to tile cleanly
 _TQ_DECODE = 128   # decode q-tile rows (real q rows are 1..few, padded up)
 
@@ -117,7 +139,7 @@ def flash_attention_available(q, k, v, mask):
         return False
     if mask is not None and not _key_mask_normalizable(mask, b, s_k):
         return False
-    return (s_k >= 128 and _BQ % _BK == 0 and
+    return (s_k >= 128 and
             d in (64, 128, 256) and q.dtype in (jnp.float32, jnp.bfloat16))
 
 
@@ -173,7 +195,10 @@ def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
                                 preferred_element_type=jnp.float32
                                 ) * _np.float32(scale)               # [BQ,BK]
         if has_kmask:
-            s = s + kmask_ref[:, pl.ds(kb * bk, bk)]                 # [1,BK]
+            # kmask rides as [B,1,S_k]: a (1,1,S_k) block keeps the minor-2
+            # dims Mosaic-tileable (a raw [B,S_k] block (1,S_k) is rejected
+            # on real TPU — caught by tools/tpu_kernel_check.py on silicon)
+            s = s + kmask_ref[0, :, pl.ds(kb * bk, bk)]              # [1,BK]
         s = _mask_scores(s, causal, qi, kb, bq, bk, q_off, kv_valid)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [BQ,1]
         p = jnp.exp(s - m_new)
@@ -200,20 +225,23 @@ def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
 
 
 def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
-               g=1):
+               g=1, bq=None, bk=None):
     """q: [BH, S_q, D]; k/v: [BH//g, S_k, D] (g = query-group size, GQA)
     -> (out [BH,S_q,D], lse [BH,S_q]). Each kv row serves its g query heads
     via the block index map — repeated KV is never materialized.
-    kmask: additive f32 [B, S_k] (BH = B*h, mask row b//h) or None."""
+    kmask: additive f32 [B, S_k] (BH = B*h, mask row b//h) or None.
+    bq/bk: block rows (must divide s_q/s_k); auto-picked when None."""
     bh, s_q, d = q.shape
     s_k = int(k.shape[1])
+    if bq is None or bk is None:
+        bq, bk = _pick_blocks(s_q, s_k)
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, s_q // _BQ)
+    grid = (bh, s_q // bq)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               bq=_BQ, bk=_BK, q_off=q_off, kv_valid=kv_valid,
+                               bq=bq, bk=bk, q_off=q_off, kv_valid=kv_valid,
                                has_kmask=kmask is not None)
     in_specs = [
-        pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, _np.int32(0))),
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, _np.int32(0))),
         pl.BlockSpec((1, s_k, d),
                      lambda b, i: (b // g, _np.int32(0), _np.int32(0))),
         pl.BlockSpec((1, s_k, d),
@@ -221,16 +249,17 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
     ]
     args = [q, k, v]
     if kmask is not None:
-        in_specs.append(pl.BlockSpec((1, s_k),
-                                     lambda b, i: (b // h, _np.int32(0))))
-        args.append(kmask)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, s_k),
+            lambda b, i: (b // h, _np.int32(0), _np.int32(0))))
+        args.append(kmask[:, None, :])
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, _np.int32(0))),
-            pl.BlockSpec((1, _BQ, _LANES), lambda b, i: (b, i, _np.int32(0))),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, _np.int32(0))),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, _np.int32(0))),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
@@ -242,7 +271,7 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
 
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                   kmask=None, h=1, groups=1):
+                   kmask=None, h=1, groups=1, bk=None):
     """Blockwise gradients (scan over k-blocks), fp32 accumulation.
     GQA (groups>1): kv repeated across the group here (fallback path),
     group-partial dk/dv summed at the end."""
@@ -251,13 +280,16 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
         vx = jnp.repeat(v, groups, axis=0)
         dq, dkp, dvp = _bwd_blockwise(q, kx, vx, out, lse, g, causal,
                                       q_off=q_off, kv_valid=kv_valid,
-                                      kmask=kmask, h=h)
+                                      kmask=kmask, h=h, bk=bk)
         shp = (k.shape[0], groups) + tuple(k.shape[1:])
         dk = dkp.astype(jnp.float32).reshape(shp).sum(1).astype(k.dtype)
         dv = dvp.astype(jnp.float32).reshape(shp).sum(1).astype(v.dtype)
         return dq, dk, dv
     bh, s_q, d = q.shape
     s_k = k.shape[1]
+    if bk is None:
+        bk = _pick_block(int(s_k), _BK_CAP)
+    _BK = bk                     # local block size for the k-scan below
     scale = 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32) * scale
     kf = k.astype(jnp.float32)
@@ -325,7 +357,7 @@ def _bwd_dq_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
                                 preferred_element_type=jnp.float32
                                 ) * _np.float32(scale)
         if has_kmask:
-            s = s + kmask_ref[:, pl.ds(kb * bk, bk)]
+            s = s + kmask_ref[0, :, pl.ds(kb * bk, bk)]
         s = _mask_scores(s, causal, qi, kb, bq, bk, q_off, kv_valid)
         p = jnp.exp(s - lse)                                   # [BQ, BK] f32
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
@@ -354,7 +386,7 @@ def _bwd_dkv_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
     nqb = q_ref.shape[1] // bq
     d = kblk.shape[-1]
     if has_kmask:
-        km = kmask_ref[:, pl.ds(ki * bk, bk)]                  # [1, BK]
+        km = kmask_ref[0, :, pl.ds(ki * bk, bk)]               # [1, BK]
 
     def body(qb, carry):
         # native-dtype MXU operands, f32 accumulation (see _fwd_kernel
@@ -404,16 +436,16 @@ def bwd_broadcasts(out, lse, g):
 
 
 def _bwd_pallas(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                kmask=None, h=1, groups=1):
+                kmask=None, h=1, groups=1, bq=None, bk=None):
     """Flash backward via the two-kernel pallas split; fp32 accumulation."""
     lse_b, dta_b = bwd_broadcasts(out, lse, g)
     return _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=q_off,
                            kv_valid=kv_valid, kmask=kmask, h=h,
-                           groups=groups)
+                           groups=groups, bq=bq, bk=bk)
 
 
 def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
-                    kmask=None, h=1, groups=1):
+                    kmask=None, h=1, groups=1, bq=None, bk=None):
     """Backward kernels with the lse/delta broadcasts precomputed.
 
     GQA (groups>1): k/v have BH//groups rows. dq streams the shared kv row
@@ -421,6 +453,9 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     partials that are summed (f32) into the kv-head gradient."""
     bh, s_q, d = q.shape
     s_k = int(k.shape[1])
+    if bq is None or bk is None:
+        bq, bk = _pick_blocks(s_q, s_k)
+    _BQ, _BK = bq, bk            # local block sizes for the specs below
     scale = 1.0 / math.sqrt(d)
     has_kmask = kmask is not None
 
@@ -428,7 +463,10 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     kvfull = lambda b, i: (b // groups, _np.int32(0), _np.int32(0))
     kvblk = lambda b, i: (b // groups, i, _np.int32(0))
     blk = lambda b, i: (b, i, _np.int32(0))
-    mrow = lambda b, i: (b // h, _np.int32(0))
+    # kmask rides [B,1,S_k] (see _flash_fwd: 2-D mask blocks are untileable
+    # on real Mosaic)
+    mrow3 = lambda b, i: (b // h, _np.int32(0), _np.int32(0))
+    kmask3 = kmask[:, None, :] if has_kmask else None
 
     dq_in_specs = [
         pl.BlockSpec((1, _BQ, d), blk),          # q
@@ -440,8 +478,8 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     ]
     dq_args = [q, k, v, g, lse_b, dta_b]
     if has_kmask:
-        dq_in_specs.append(pl.BlockSpec((1, s_k), mrow))
-        dq_args.append(kmask)
+        dq_in_specs.append(pl.BlockSpec((1, 1, s_k), mrow3))
+        dq_args.append(kmask3)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           bq=_BQ, bk=_BK, q_off=q_off, kv_valid=kv_valid,
@@ -463,8 +501,8 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     ]
     dkv_args = [q, k, v, g, lse_b, dta_b]
     if has_kmask:
-        dkv_in_specs.append(pl.BlockSpec((1, s_k), mrow))
-        dkv_args.append(kmask)
+        dkv_in_specs.append(pl.BlockSpec((1, 1, s_k), mrow3))
+        dkv_args.append(kmask3)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           bq=_BQ, bk=_BK, q_off=q_off, kv_valid=kv_valid,
@@ -488,29 +526,29 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, kmask, causal, q_off, kv_valid, h, groups):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, kmask, causal, q_off, kv_valid, h, groups, bq, bk):
     out, _ = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                        kmask=kmask, h=h, g=groups)
+                        kmask=kmask, h=h, g=groups, bq=bq, bk=bk)
     return out
 
 
-def _flash_f(q, k, v, kmask, causal, q_off, kv_valid, h, groups):
+def _flash_f(q, k, v, kmask, causal, q_off, kv_valid, h, groups, bq, bk):
     out, lse = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                          kmask=kmask, h=h, g=groups)
+                          kmask=kmask, h=h, g=groups, bq=bq, bk=bk)
     return out, (q, k, v, kmask, out, lse)
 
 
-def _flash_b(causal, q_off, kv_valid, h, groups, res, g):
+def _flash_b(causal, q_off, kv_valid, h, groups, bq, bk, res, g):
     q, k, v, kmask, out, lse = res
     if os.environ.get('PADDLE_TPU_FLASH_JNP_BWD') == '1':
         dq, dk, dv = _bwd_blockwise(q, k, v, out, lse, g, causal,
                                     q_off=q_off, kv_valid=kv_valid,
-                                    kmask=kmask, h=h, groups=groups)
+                                    kmask=kmask, h=h, groups=groups, bk=bk)
     else:
         dq, dk, dv = _bwd_pallas(q, k, v, out, lse, g, causal, q_off=q_off,
                                  kv_valid=kv_valid, kmask=kmask, h=h,
-                                 groups=groups)
+                                 groups=groups, bq=bq, bk=bk)
     dmask = None if kmask is None else jnp.zeros_like(kmask)
     return dq, dk, dv, dmask
 
@@ -588,8 +626,9 @@ def flash_attention(q, k, v, causal=False, mask=None):
     kmask = (_normalize_key_mask(mask, b, s_k)
              if mask is not None else None)
     q_off = (s_k - s_q) if causal else 0
-    s_q_pad = -(-s_q // _BQ) * _BQ
-    s_k_pad = -(-s_k // _BK) * _BK
+    bq, bk = _pick_blocks(s_q, s_k)
+    s_q_pad = -(-s_q // bq) * bq
+    s_k_pad = -(-s_k // bk) * bk
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * hh, s_q, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_k, d)
@@ -606,7 +645,8 @@ def flash_attention(q, k, v, causal=False, mask=None):
         else:
             kv_valid = s_k          # static in-kernel bound, no mask array
 
-    out = _flash(qt, kt, vt, kmask, causal, q_off, kv_valid, hh, groups)
+    out = _flash(qt, kt, vt, kmask, causal, q_off, kv_valid, hh, groups,
+                 bq, bk)
     out = out[:, :s_q]
     return out.reshape(b, hh, s_q, d).transpose(0, 2, 1, 3)
 

@@ -92,8 +92,10 @@ def ring_flash_available(q, k=None, axis_name='sp'):
     fa = sys.modules['paddle_tpu.ops.flash_attention']
     kv = q if k is None else k
     s_local = int(q.shape[1])
+    # blocks are auto-picked per call (fa._pick_blocks); any 128-multiple
+    # local shard tiles exactly
     return (fa.flash_attention_available(q, kv, kv, None)
-            and s_local % fa._BQ == 0 and s_local % fa._BK == 0)
+            and s_local % 128 == 0)
 
 
 def _bhsd(x):

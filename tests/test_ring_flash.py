@@ -185,6 +185,8 @@ def test_ring_gate_requires_tiling_local_shard():
     """The ring path runs the kernels WITHOUT the public wrapper's padding:
     non-block-multiple local shards must be declined (review r4)."""
     ok = jnp.zeros((1, 512, 2, 64))
-    bad = jnp.zeros((1, 384, 2, 64))     # 384 % 256 != 0
+    ok384 = jnp.zeros((1, 384, 2, 64))   # tiles with auto-picked 128 blocks
+    bad = jnp.zeros((1, 320, 2, 64))     # 320 % 128 != 0
     assert ra.ring_flash_available(ok)
+    assert ra.ring_flash_available(ok384)
     assert not ra.ring_flash_available(bad)

@@ -1,0 +1,211 @@
+"""On-chip pallas kernel parity validation (VERDICT r3 'Weak' #5 closure).
+
+The CI suite covers every kernel shape class in pallas interpret mode on
+CPU; this tool re-runs the same parity checks COMPILED UNDER REAL MOSAIC on
+the live TPU, one bounded process for the whole battery, one JSON line out:
+
+  {"tpu_kernel_checks": {"fwd_causal": {"ok": true, "max_diff": ...}, ...},
+   "all_ok": true, "platform": "tpu"}
+
+Checks mirror tests/test_flash_attention.py: self-attn fwd+grad (causal /
+full), key-padding mask, cross-attention (aligned-ends causal),
+non-block-multiple seq (pad + static bound), GQA fwd+grad, flash_decode
+(traced position), int8-KV flash_decode, and the blockwise LM-head xent
+(ops/xent.py) fwd+grad vs the naive logits path.
+
+Run:  python tools/tpu_kernel_check.py          (on the chip)
+      BENCH_FORCE_CPU=1 python tools/tpu_kernel_check.py   (interp off-chip)
+"""
+import json
+import math
+import os
+import signal
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _watchdog(s):
+    signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(
+        SystemExit(f'watchdog: {s}s elapsed')))
+    signal.alarm(s)
+
+
+def main():
+    _watchdog(int(os.environ.get('KCHECK_TIMEOUT', '540')))
+    import jax
+    if os.environ.get('BENCH_FORCE_CPU') == '1':
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+
+    import importlib
+    # the package re-exports shadow the submodule attributes — resolve the
+    # real modules (same trick as tests/test_flash_attention.py)
+    fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+    xent = importlib.import_module('paddle_tpu.ops.xent')
+    from paddle_tpu.ops.weight_only import dequantize_kv, quantize_kv
+
+    platform = jax.devices()[0].platform
+    if platform not in ('tpu', 'axon'):
+        # ALWAYS interpret off-chip: otherwise flash_attention silently
+        # falls back to the very XLA path we compare against and the parity
+        # checks pass vacuously (review r4)
+        fa.set_interpret(True)
+
+    results = {}
+
+    def check(name, fn, tol):
+        try:
+            diff = float(fn())
+            results[name] = {'ok': bool(diff <= tol), 'max_diff': diff,
+                             'tol': tol}
+        except Exception as e:  # noqa: BLE001 — record, keep battery going
+            results[name] = {'ok': False,
+                             'error': f'{type(e).__name__}: {e}'[:300]}
+
+    def rand(key, shape, dtype=jnp.float32):
+        return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+    def maxdiff(a, b):
+        """RELATIVE max deviation: on real TPU both sides run their dots on
+        the MXU (bf16 multiplicands, f32 accum) but with different tilings,
+        so elementwise agreement is bounded by bf16 epsilon × magnitude —
+        absolute f32 tolerances only make sense in CPU interpret mode."""
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        return jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-6)
+
+    # -- self-attention fwd/grad ------------------------------------------
+    b, s, h, d = 2, 512, 4, 64
+    q, k, v = (rand(i, (b, s, h, d)) for i in range(3))
+
+    def fwd(causal):
+        def f():
+            got = fa.flash_attention(q, k, v, causal=causal)
+            want = fa._jnp_attention(q, k, v, causal, None)
+            return maxdiff(got, want)
+        return f
+
+    check('fwd_causal', fwd(True), 2e-2)
+    check('fwd_full', fwd(False), 2e-2)
+
+    def grad_causal():
+        def loss_flash(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(fa._jnp_attention(q, k, v, True, None) ** 2)
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        return max(float(maxdiff(a, c)) for a, c in zip(g1, g2))
+    check('grad_causal', grad_causal, 2e-2)
+
+    # -- bf16 fwd ---------------------------------------------------------
+    def bf16_fwd():
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        got = fa.flash_attention(qb, kb, vb, causal=True)
+        want = fa._jnp_attention(qb, kb, vb, True, None)
+        return maxdiff(got, want)
+    check('fwd_bf16', bf16_fwd, 5e-2)
+
+    # -- key-padding mask -------------------------------------------------
+    def masked():
+        mask = (jnp.arange(s)[None, :] < jnp.asarray([s, s // 2])[:, None])
+        got = fa.flash_attention(q, k, v, causal=True, mask=mask)
+        want = fa._jnp_attention(q, k, v, True, mask)
+        return maxdiff(got, want)
+    check('key_padding_mask', masked, 2e-2)
+
+    # -- cross-attention (aligned-ends causal) ----------------------------
+    def cross():
+        qq = rand(7, (b, 256, h, d))
+        got = fa.flash_attention(qq, k, v, causal=True)
+        want = fa._jnp_attention(qq, k, v, True, None)
+        return maxdiff(got, want)
+    check('cross_causal', cross, 2e-2)
+
+    # -- non-block-multiple seq -------------------------------------------
+    def ragged():
+        qq, kk, vv = (rand(i + 11, (b, 300, h, d)) for i in range(3))
+        got = fa.flash_attention(qq, kk, vv, causal=True)
+        want = fa._jnp_attention(qq, kk, vv, True, None)
+        return maxdiff(got, want)
+    check('non_block_multiple', ragged, 2e-2)
+
+    # -- GQA fwd + grad ---------------------------------------------------
+    kg, vg = (rand(i + 21, (b, s, 1, d)) for i in range(2))
+
+    def gqa_fwd():
+        got = fa.flash_attention(q, kg, vg, causal=True)
+        want = fa._jnp_attention(q, kg, vg, True, None)
+        return maxdiff(got, want)
+    check('gqa_mqa_fwd', gqa_fwd, 2e-2)
+
+    def gqa_grad():
+        def lf(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+        def lr(q, k, v):
+            return jnp.sum(fa._jnp_attention(q, k, v, True, None) ** 2)
+        g1 = jax.grad(lf, argnums=(0, 1, 2))(q, kg, vg)
+        g2 = jax.grad(lr, argnums=(0, 1, 2))(q, kg, vg)
+        return max(float(maxdiff(a, c)) for a, c in zip(g1, g2))
+    check('gqa_mqa_grad', gqa_grad, 2e-2)
+
+    # -- flash decode (traced position) -----------------------------------
+    s_max, pos = 512, 173
+    kc, vc = (rand(i + 31, (b, s_max, h, d)) for i in range(2))
+    q1 = rand(33, (b, 1, h, d))
+
+    def decode():
+        assert fa.flash_decode_available(q1, kc)
+        got = jax.jit(fa.flash_decode)(q1, kc, vc, jnp.int32(pos))
+        want = fa._jnp_attention(
+            q1, kc[:, :pos + 1], vc[:, :pos + 1], False, None)
+        return maxdiff(got, want)
+    check('decode_traced_pos', decode, 2e-2)
+
+    # -- int8-KV flash decode ---------------------------------------------
+    def decode_int8():
+        kq, ks = quantize_kv(kc)
+        vq, vs = quantize_kv(vc)
+        kbank = {'int8': kq, 'scale': ks}
+        vbank = {'int8': vq, 'scale': vs}
+        got = jax.jit(fa.flash_decode_int8)(q1, kbank, vbank, jnp.int32(pos))
+        kf = dequantize_kv(kq, ks, jnp.float32)
+        vf = dequantize_kv(vq, vs, jnp.float32)
+        want = fa._jnp_attention(
+            q1, kf[:, :pos + 1], vf[:, :pos + 1], False, None)
+        return maxdiff(got, want)
+    check('decode_int8_kv', decode_int8, 2e-2)
+
+    # -- blockwise LM-head xent vs naive ----------------------------------
+    def xent_check():
+        nn, hh, vv = 512, 256, 4096
+        x = rand(41, (nn, hh)) * 0.1
+        w = rand(42, (vv, hh)) * 0.05
+        y = jax.random.randint(jax.random.PRNGKey(43), (nn,), 0, vv)
+
+        def blockwise(x, w):
+            return xent.softmax_xent_blockwise(x, w, y, 1024)
+
+        def naive(x, w):
+            logits = (x @ w.T).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, y[:, None], -1)[:, 0]
+            return jnp.mean(lse - tgt)
+        l1, g1 = jax.value_and_grad(blockwise, argnums=(0, 1))(x, w)
+        l2, g2 = jax.value_and_grad(naive, argnums=(0, 1))(x, w)
+        return max(float(abs(l1 - l2)),
+                   *[float(maxdiff(a, c)) for a, c in zip(g1, g2)])
+    check('blockwise_xent', xent_check, 2e-3)
+
+    all_ok = all(r.get('ok') for r in results.values())
+    print(json.dumps({'tpu_kernel_checks': results, 'all_ok': all_ok,
+                      'platform': platform}))
+    return 0 if all_ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

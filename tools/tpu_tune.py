@@ -33,7 +33,8 @@ def child(cfg):
     batch, seq = cfg['batch'], cfg['seq']
     gcfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
                          num_heads=16, max_seq_len=seq, dtype='bfloat16',
-                         remat=cfg['remat'], use_flash=cfg['flash'])
+                         remat=cfg['remat'], use_flash=cfg['flash'],
+                         remat_policy=cfg.get('policy', 'full'))
     params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
     opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
@@ -66,17 +67,40 @@ def child(cfg):
 
 def main():
     quick = '--quick' in sys.argv
+    round2 = '--round2' in sys.argv
     variants = []
     for batch, seq in ((8, 1024), (16, 1024), (32, 1024), (4, 2048), (8, 2048)):
         variants.append(dict(batch=batch, seq=seq, flash=True, remat=True))
     variants += [
-        dict(batch=8, seq=1024, flash=True, remat=False),
-        dict(batch=16, seq=1024, flash=True, remat=False),
+        # remat=False @350M/batch8 is a measured HBM OOM on v5e (scan carries
+        # bf16[24,8,1024,1024] temps) — 'dots' selective remat is the middle
+        # ground: matmul outputs saved, elementwise recomputed
+        dict(batch=8, seq=1024, flash=True, remat=True, policy='dots'),
+        dict(batch=16, seq=1024, flash=True, remat=True, policy='dots'),
         dict(batch=8, seq=1024, flash=False, remat=True),
         dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=256),
         dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=512),
         dict(batch=8, seq=1024, flash=True, remat=True, bq=128, bk=128),
     ]
+    if round2:
+        # measured r4 on-chip: bq512/bk512 won round 1 at 34.0k tok/s
+        # (+13% over 256/256). All round-2 variants run policy='dots' so
+        # the table varies ONE dimension (review r4: the first pass
+        # confounded block size with remat policy, and bk>bq variants were
+        # silently clamped to bk=bq by _pick_blocks — both dropped/fixed;
+        # same-policy pass still showed bq1024 < bq512 at 'full').
+        variants = [
+            dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=512,
+                 policy='dots'),
+            dict(batch=8, seq=1024, flash=True, remat=True, bq=1024, bk=512,
+                 policy='dots'),
+            dict(batch=8, seq=1024, flash=True, remat=True, bq=1024,
+                 bk=1024, policy='dots'),
+            dict(batch=8, seq=1024, flash=True, remat=True, bq=256, bk=256,
+                 policy='dots'),
+            dict(batch=16, seq=1024, flash=True, remat=True, bq=512, bk=512,
+                 policy='dots'),
+        ]
     if quick:
         variants = variants[:3]
     results = []
