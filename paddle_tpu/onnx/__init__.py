@@ -32,6 +32,12 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     from ..static import InputSpec
 
     base = path[:-len('.onnx')] if path.endswith('.onnx') else path
+    for spec in input_spec or []:
+        if (isinstance(spec, InputSpec)
+                and any(d in (None, -1) for d in list(spec.shape)[1:])):
+            raise ValueError(
+                'only the LEADING (batch) dim may be dynamic in an ONNX '
+                f'export; got InputSpec shape {list(spec.shape)}')
     jit_mod.save(layer, base, input_spec=input_spec)
 
     if input_spec is None:
@@ -73,9 +79,11 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
               + jax.tree_util.tree_leaves(buffers))
     for var, val in zip(weight_vars, flat_w):
         ex.const_vals[var] = np.asarray(val)
+    spec_shapes = [list(s.shape) if isinstance(s, InputSpec)
+                   else list(np.asarray(s).shape) for s in input_spec]
     model_bytes = ex.build(jaxpr, input_vars,
                            [f'input_{i}' for i in range(len(input_vars))],
-                           opset=opset_version)
+                           opset=opset_version, input_shapes=spec_shapes)
     out_path = base + '.onnx'
     with open(out_path, 'wb') as f:
         f.write(model_bytes)

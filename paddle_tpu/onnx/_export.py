@@ -179,6 +179,11 @@ class Exporter:
         elif name == 'rsqrt':
             s = self.emit('Sqrt', [self.name_of(eqn.invars[0])])
             self.names[out] = self.emit('Reciprocal', [s])
+        elif name == 'erfc':
+            # erfc(x) = 1 - erf(x) (exact-GELU lowers through erfc)
+            e = self.emit('Erf', [self.name_of(eqn.invars[0])])
+            one = self.add_const(np.asarray(1, eqn.invars[0].aval.dtype))
+            self.names[out] = self.emit('Sub', [one, e])
         elif name == 'square':
             x = self.name_of(eqn.invars[0])
             self.names[out] = self.emit('Mul', [x, x])
@@ -202,14 +207,10 @@ class Exporter:
             to = P.DTYPES[np.dtype(eqn.params['new_dtype'])]
             self.names[out] = self.emit(
                 'Cast', [self.name_of(eqn.invars[0])], to=to)
-        elif name == 'reshape':
-            shp = self.add_const(np.asarray(_shape(out), np.int64))
+        elif name in ('reshape', 'squeeze'):
             self.names[out] = self.emit(
-                'Reshape', [self.name_of(eqn.invars[0]), shp])
-        elif name == 'squeeze':
-            shp = self.add_const(np.asarray(_shape(out), np.int64))
-            self.names[out] = self.emit(
-                'Reshape', [self.name_of(eqn.invars[0]), shp])
+                'Reshape', [self.name_of(eqn.invars[0]),
+                            self._dyn0_shape(_shape(out))])
         elif name == 'transpose':
             self.names[out] = self.emit(
                 'Transpose', [self.name_of(eqn.invars[0])],
@@ -220,8 +221,10 @@ class Exporter:
             mid = [1] * len(_shape(out))
             for i, od in enumerate(bcd):
                 mid[od] = _shape(eqn.invars[0])[i]
-            shp_mid = self.add_const(np.asarray(mid, np.int64))
-            x = self.emit('Reshape', [x, shp_mid])
+            x = self.emit('Reshape', [x, self._dyn0_shape(mid)])
+            # Expand target stays static: ONNX Expand BROADCASTS (a target
+            # dim of 1 keeps the input dim), so a dynamic batch flowing
+            # through the input survives a traced-batch-1 target
             shp = self.add_const(np.asarray(_shape(out), np.int64))
             self.names[out] = self.emit('Expand', [x, shp])
         elif name == 'concatenate':
@@ -283,6 +286,22 @@ class Exporter:
         else:
             self._inline(eqn)
 
+    def _dyn0_shape(self, shape):
+        """Reshape target with the leading dim emitted as -1 (inferred).
+
+        The graph is traced at batch=1, so baking the traced leading dim
+        into Reshape targets breaks dynamic-batch inference (journey r4:
+        MatMul operand flattens carried a literal batch). Guards (review
+        r4): only when the export requested a dynamic batch, and only when
+        the traced leading dim IS the traced batch value 1 — a reshape
+        whose leading dim is some other size (e.g. seq-major flatten)
+        stays static and fails loudly at runtime rather than silently
+        mis-reshaping."""
+        t = list(int(d) for d in shape)
+        if t and t[0] == 1 and getattr(self, '_dyn0', False):
+            t[0] = -1
+        return self.add_const(np.asarray(t, np.int64))
+
     # ---- structured ops -------------------------------------------------
     def _dot(self, eqn):
         lhs, rhs = eqn.invars
@@ -302,13 +321,21 @@ class Exporter:
         m = int(np.prod([lsh[d] for d in l_free], dtype=np.int64))
         n = int(np.prod([rsh[d] for d in r_free], dtype=np.int64))
         batch = [lsh[d] for d in lb]
-        l2 = self.add_const(np.asarray(batch + [m, k], np.int64))
-        r2 = self.add_const(np.asarray(batch + [k, n], np.int64))
-        ln = self.emit('Reshape', [ln, l2])
-        rn = self.emit('Reshape', [rn, r2])
+        l_tgt = batch + [m, k]
+        if (getattr(self, '_dyn0', False) and not lb and l_free
+                and l_free[0] == 0):
+            # the rows slot MERGES the leading batch with other free dims
+            # (m = B * ...), so it must be inferred even when m != 1 —
+            # e.g. Embedding output [B,S,E] flattening to [B*S, E]
+            l_tgt = [-1, k]
+            ln_shaped = self.add_const(np.asarray(l_tgt, np.int64))
+        else:
+            ln_shaped = self._dyn0_shape(l_tgt)
+        ln = self.emit('Reshape', [ln, ln_shaped])
+        rn = self.emit('Reshape', [rn, self._dyn0_shape(batch + [k, n])])
         mm = self.emit('MatMul', [ln, rn])
-        fin = self.add_const(np.asarray(_shape(eqn.outvars[0]), np.int64))
-        self.names[eqn.outvars[0]] = self.emit('Reshape', [mm, fin])
+        self.names[eqn.outvars[0]] = self.emit(
+            'Reshape', [mm, self._dyn0_shape(_shape(eqn.outvars[0]))])
 
     def _conv(self, eqn):
         lhs, rhs = eqn.invars
@@ -391,8 +418,8 @@ class Exporter:
             axis = dn.start_index_map[0]
             idx_name = self.name_of(idx)
             ish = _shape(idx)[:-1]
-            shp = self.add_const(np.asarray(ish, np.int64))
-            idx_name = self.emit('Reshape', [idx_name, shp])
+            idx_name = self.emit('Reshape',
+                                 [idx_name, self._dyn0_shape(ish)])
             self.names[eqn.outvars[0]] = self.emit(
                 'Gather', [self.name_of(operand), idx_name], axis=axis)
         else:
@@ -400,11 +427,28 @@ class Exporter:
                                   'single-axis gathers are exported)')
 
     # ---- finish ---------------------------------------------------------
-    def build(self, jaxpr, input_vars, input_names, opset=13):
+    def build(self, jaxpr, input_vars, input_names, opset=13,
+              input_shapes=None):
+        """input_shapes: optional per-input shapes with None for symbolic
+        dims (from the user's InputSpec) — emitted as dim_param so ONNX
+        consumers accept dynamic batches; traced dims otherwise."""
         inputs = []
-        for var, iname in zip(input_vars, input_names):
+        dyn_batch = False
+        for idx, (var, iname) in enumerate(zip(input_vars, input_names)):
             self.names[var] = iname
-            inputs.append(P.value_info(iname, var.aval.dtype, _shape(var)))
+            shape = _shape(var)
+            if input_shapes is not None and idx < len(input_shapes):
+                spec = list(input_shapes[idx])
+                if len(spec) == len(shape):
+                    if any(s in (None, -1) for s in spec[1:]):
+                        raise OnnxExportError(
+                            'only the LEADING (batch) dim may be dynamic '
+                            f'in an ONNX export; got InputSpec shape {spec}')
+                    shape = [None if s in (None, -1) else d
+                             for s, d in zip(spec, shape)]
+                    dyn_batch = dyn_batch or None in shape
+            inputs.append(P.value_info(iname, var.aval.dtype, shape))
+        self._dyn0 = dyn_batch      # consulted by _dyn0_shape during run
         self.run(jaxpr)
         outputs = []
         for i, ov in enumerate(jaxpr.outvars):
@@ -412,8 +456,12 @@ class Exporter:
             if ov in self.const_vals and oname in self.initializers:
                 # constant output: route through Identity so it is a node
                 oname = self.emit('Identity', [oname])
+            oshape = list(_shape(ov))
+            if dyn_batch and oshape and oshape[0] == 1:
+                # traced batch was 1; a dynamic input batch flows through
+                oshape[0] = None
             outputs.append(P.value_info(f'output_{i}', ov.aval.dtype,
-                                        _shape(ov)))
+                                        oshape))
             self.nodes.append(P.node('Identity', [oname], [f'output_{i}']))
         inits = [P.tensor(n, a) for n, a in self.initializers.items()]
         g = P.graph(self.nodes, self.graph_name, inits, inputs, outputs)

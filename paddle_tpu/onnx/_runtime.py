@@ -67,14 +67,16 @@ def run_model(parsed_or_bytes, inputs):
             r = (np.fmod if a.get('fmod') else np.mod)(x[0], x[1])
         elif op == 'Relu':
             r = np.maximum(x[0], 0)
-        elif op in ('Exp', 'Log', 'Tanh', 'Neg', 'Abs', 'Sqrt', 'Floor',
+        elif op in ('Exp', 'Log', 'Tanh', 'Abs', 'Sqrt', 'Floor',
                     'Ceil', 'Sign', 'Sin', 'Cos'):
             r = getattr(np, op.lower())(x[0])
+        elif op == 'Neg':
+            r = np.negative(x[0])                 # numpy spells it negative
         elif op == 'Sigmoid':
             r = 1.0 / (1.0 + np.exp(-x[0]))
         elif op == 'Erf':
-            from scipy.special import erf as _erf          # pragma: no cover
-            r = _erf(x[0])
+            import jax.scipy.special as _jsp      # no scipy dep in-image
+            r = np.asarray(_jsp.erf(x[0]))
         elif op == 'Reciprocal':
             r = 1.0 / x[0]
         elif op in ('And', 'Or', 'Not'):
@@ -94,7 +96,12 @@ def run_model(parsed_or_bytes, inputs):
         elif op == 'Reshape':
             r = x[0].reshape([int(d) for d in x[1]])
         elif op == 'Expand':
-            r = np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+            # ONNX Expand broadcasts BIDIRECTIONALLY (a target dim of 1
+            # keeps the input dim) — np.broadcast_to alone is one-way and
+            # rejects a dynamic batch flowing through a traced-1 target
+            tgt = np.broadcast_shapes(x[0].shape,
+                                      tuple(int(d) for d in x[1]))
+            r = np.broadcast_to(x[0], tgt).copy()
         elif op == 'Transpose':
             r = np.transpose(x[0], a['perm'])
         elif op == 'Concat':

@@ -530,3 +530,37 @@ def test_gqa_flash_decode():
     want = jnp.einsum('bhqk,bkhd->bqhd', jax.nn.softmax(sc, -1), vr)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_pick_blocks_invariants():
+    """r4: blocks are auto-picked per call (512-row cap measured fastest on
+    v5e). Invariants the kernels rely on: bk | bq, both divide the padded
+    seqs, 128-row tiling minimum."""
+    for s_q in (128, 256, 300, 384, 512, 640, 1024, 4096, 130):
+        for s_k in (128, 256, 300, 512, 1024, 4096):
+            bq, bk = fa._pick_blocks(s_q, s_k)
+            assert bq % 128 == 0 and bk % 128 == 0
+            assert bq % bk == 0, (s_q, s_k, bq, bk)
+            # padding stays at 128-row granularity: the picker must divide
+            # the 128-padded length, never force extra padding beyond it
+            s_q128 = -(-s_q // 128) * 128
+            s_k128 = -(-s_k // 128) * 128
+            assert s_q128 % bq == 0, (s_q, bq)
+            assert s_k128 % bk == 0, (s_k, bk)
+    # the tuned default: big seqs pick the 512 sweet spot
+    assert fa._pick_blocks(1024, 1024) == (512, 512)
+    # ragged seqs keep 128-granularity padding
+    assert fa._pick_blocks(300, 300)[0] == 128
+
+
+def test_pick_blocks_env_cap(monkeypatch):
+    """Non-power-of-two env caps can't break the bk | bq invariant
+    (review r4): bk halves down to the 128 floor."""
+    monkeypatch.setattr(fa, '_BQ_CAP', 384)
+    monkeypatch.setattr(fa, '_BK_CAP', 512)
+    bq, bk = fa._pick_blocks(768, 256)
+    assert bq % bk == 0 and bk >= 128
+    monkeypatch.setattr(fa, '_BQ_CAP', 512)
+    monkeypatch.setattr(fa, '_BK_CAP', 384)
+    bq, bk = fa._pick_blocks(512, 768)
+    assert bq % bk == 0 and bk >= 128

@@ -148,3 +148,85 @@ def test_rem_mod_semantics():
 
     import jax
     _roundtrip(RemNet(), (8,), seed=4)
+
+
+def test_dynamic_batch_and_gelu_export():
+    """Journey-found r4: (a) exact GELU lowers through erfc — exporter must
+    map it (1 - Erf); (b) tracing at batch=1 must not bake the batch into
+    Reshape targets — running the exported graph at a DIFFERENT batch is
+    the dynamic-batch contract of InputSpec [None, ...]; (c) the reference
+    runtime executes Neg/Erf (no scipy in-image)."""
+    import paddle_tpu.nn as nn
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.f1, self.f2 = nn.Linear(8, 16), nn.Linear(16, 4)
+
+        def forward(self, z):
+            return self.f2(paddle.nn.functional.gelu(self.f1(z)))
+
+    net = MLP()
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    spec = [paddle.static.InputSpec([None, 8], 'float32')]
+    path = ponnx.export(net, os.path.join(tmp, 'mlp'), input_spec=spec)
+    blob = open(path, 'rb').read()
+    for batch in (1, 3, 7):
+        x = np.random.RandomState(batch).rand(batch, 8).astype('float32')
+        want = np.asarray(net(paddle.to_tensor(x))._value)
+        got = ponnx.reference_run(blob, [x])[0]
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+    m = ponnx.parse_model(blob)
+    assert {'Erf', 'Neg'} <= {n['op_type'] for n in m['nodes']}
+
+
+def test_dynamic_batch_softmax_and_embedding():
+    """Review r4 repros: broadcast_in_dim (softmax keepdims) and gather
+    (embedding) must survive a runtime batch different from the traced 1."""
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(8, 6), nn.Softmax())
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    path = ponnx.export(net, os.path.join(tmp, 'sm'),
+                        input_spec=[paddle.static.InputSpec([None, 8],
+                                                            'float32')])
+    blob = open(path, 'rb').read()
+    x = np.random.RandomState(0).rand(3, 8).astype('float32')
+    got = ponnx.reference_run(blob, [x])[0]
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.e = nn.Embedding(32, 8)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, t):
+            return self.fc(self.e(t))
+
+    enet = Emb()
+    enet.eval()
+    epath = ponnx.export(enet, os.path.join(tmp, 'emb'),
+                         input_spec=[paddle.static.InputSpec([None, 5],
+                                                             'int64')])
+    eblob = open(epath, 'rb').read()
+    ix = np.random.RandomState(1).randint(0, 32, (3, 5)).astype('int64')
+    egot = ponnx.reference_run(eblob, [ix])[0]
+    ewant = np.asarray(enet(paddle.to_tensor(ix))._value)
+    np.testing.assert_allclose(egot, ewant, atol=1e-5, rtol=1e-4)
+
+
+def test_non_leading_dynamic_dim_raises():
+    """Only the leading (batch) dim may be dynamic — anything else would
+    advertise a dim_param the graph cannot honor (review r4)."""
+    import paddle_tpu.nn as nn
+    net = nn.Linear(8, 4)
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    with pytest.raises(Exception, match='LEADING'):
+        ponnx.export(net, os.path.join(tmp, 'bad'),
+                     input_spec=[paddle.static.InputSpec([2, None],
+                                                         'float32')])
