@@ -236,6 +236,21 @@ class Exporter:
             ends = list(eqn.params['limit_indices'])
             steps = list(eqn.params['strides'] or
                          [1] * len(starts))
+            in_sh = _shape(eqn.invars[0])
+            if getattr(self, '_dyn0', False) and in_sh:
+                if (starts[0] == 0 and ends[0] == in_sh[0]
+                        and steps[0] == 1):
+                    # full pass-through on the batch axis: an end baked to
+                    # the traced batch would silently DROP rows at runtime
+                    # (review r4) — INT64_MAX means "to the end" in ONNX.
+                    # Trace-at-1 ambiguity: a literal [:1] batch slice is
+                    # indistinguishable from [:B] and exports as the latter.
+                    ends[0] = np.iinfo(np.int64).max
+                else:
+                    raise OnnxExportError(
+                        'slicing the dynamic batch axis (a sub-range of '
+                        'dim 0) cannot be exported with a dynamic batch — '
+                        'export with a static batch InputSpec instead')
             ins = [self.name_of(eqn.invars[0]),
                    self.add_const(np.asarray(starts, np.int64)),
                    self.add_const(np.asarray(ends, np.int64)),
@@ -438,12 +453,10 @@ class Exporter:
             self.names[var] = iname
             shape = _shape(var)
             if input_shapes is not None and idx < len(input_shapes):
+                # non-leading dynamic dims were rejected up front by
+                # onnx.export (the single validation point)
                 spec = list(input_shapes[idx])
                 if len(spec) == len(shape):
-                    if any(s in (None, -1) for s in spec[1:]):
-                        raise OnnxExportError(
-                            'only the LEADING (batch) dim may be dynamic '
-                            f'in an ONNX export; got InputSpec shape {spec}')
                     shape = [None if s in (None, -1) else d
                              for s, d in zip(spec, shape)]
                     dyn_batch = dyn_batch or None in shape

@@ -117,10 +117,18 @@ def quant_post_dynamic(model, sample_inputs=None, batch_nums=8,
         # paddle.reader/DataLoader readers
         sample_inputs = sample_inputs()
     if sample_inputs is not None:
+        from ..core.tensor import Tensor, to_tensor
+
+        def _as_input(v):
+            # reader creators yield raw numpy rows (reference contract) —
+            # tensorize so the quant observers see Tensor inputs
+            return v if isinstance(v, Tensor) else to_tensor(np.asarray(v))
+
         for i, batch in enumerate(sample_inputs):
             if i >= batch_nums:
                 break
-            model(*batch if isinstance(batch, (tuple, list)) else (batch,))
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            model(*[_as_input(a) for a in args])
             seen += 1
     if seen == 0:
         raise ValueError(

@@ -230,3 +230,43 @@ def test_non_leading_dynamic_dim_raises():
         ponnx.export(net, os.path.join(tmp, 'bad'),
                      input_spec=[paddle.static.InputSpec([2, None],
                                                          'float32')])
+
+
+def test_dynamic_batch_slice_passthrough_and_subrange():
+    """Review r4: a slice that passes the batch axis through untouched must
+    not bake the traced batch into its end (silent row-dropping); a genuine
+    sub-range slice of the dynamic batch axis must refuse to export."""
+    import paddle_tpu.nn as nn
+
+    class Sliced(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, t):
+            return self.fc(t[:, :4])
+
+    net = Sliced()
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    path = ponnx.export(net, os.path.join(tmp, 'sl'),
+                        input_spec=[paddle.static.InputSpec([None, 8],
+                                                            'float32')])
+    blob = open(path, 'rb').read()
+    x = np.random.RandomState(0).rand(3, 8).astype('float32')
+    got = ponnx.reference_run(blob, [x])[0]
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    assert got.shape == (3, 3)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+    # NOTE: t[:1] traced at batch=1 is indistinguishable from a full
+    # pass-through and exports as one (documented in the slice handler).
+    # A DETECTABLE sub-range (nonzero start) must refuse:
+    class BatchSliced(nn.Layer):
+        def forward(self, t):
+            return t[1:] * 2.0
+
+    with pytest.raises(Exception, match='dynamic batch'):
+        ponnx.export(BatchSliced(), os.path.join(tmp, 'bs'),
+                     input_spec=[paddle.static.InputSpec([None, 8],
+                                                         'float32')])

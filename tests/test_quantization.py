@@ -110,3 +110,21 @@ def test_ptq_reader_creator_sample_generator():
     qnet = PostTrainingQuantization(net, sample_generator=gen).quantize()
     out = qnet(paddle.to_tensor(np.random.RandomState(1).rand(2, 8).astype('f4')))
     assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_ptq_numpy_row_sample_generator():
+    """Reference readers yield RAW NUMPY rows (often tuple-wrapped, no
+    batch dim) — r4 journey: they reached the quant observers
+    un-tensorized and crashed on Tensor-only methods."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PostTrainingQuantization
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+
+    def gen():
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            yield rng.rand(8).astype('f4'),          # tuple of raw numpy
+
+    qnet = PostTrainingQuantization(net, sample_generator=gen).quantize()
+    out = qnet(paddle.to_tensor(np.random.RandomState(1).rand(2, 8).astype('f4')))
+    assert np.isfinite(np.asarray(out._value)).all()
