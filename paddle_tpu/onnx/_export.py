@@ -237,20 +237,29 @@ class Exporter:
             steps = list(eqn.params['strides'] or
                          [1] * len(starts))
             in_sh = _shape(eqn.invars[0])
-            if getattr(self, '_dyn0', False) and in_sh:
-                if (starts[0] == 0 and ends[0] == in_sh[0]
-                        and steps[0] == 1):
-                    # full pass-through on the batch axis: an end baked to
-                    # the traced batch would silently DROP rows at runtime
-                    # (review r4) — INT64_MAX means "to the end" in ONNX.
-                    # Trace-at-1 ambiguity: a literal [:1] batch slice is
-                    # indistinguishable from [:B] and exports as the latter.
-                    ends[0] = np.iinfo(np.int64).max
-                else:
-                    raise OnnxExportError(
-                        'slicing the dynamic batch axis (a sub-range of '
-                        'dim 0) cannot be exported with a dynamic batch — '
-                        'export with a static batch InputSpec instead')
+            if getattr(self, '_dyn0', False):
+                # The dynamic batch traces at size 1 but can sit at ANY dim
+                # position (e.g. seq-major after a transpose), so guard every
+                # traced-size-1 dim: a full pass-through gets INT64_MAX
+                # ("to the end" in ONNX) — correct whether the dim is the
+                # dynamic batch or genuinely size 1 — while an end baked to
+                # the traced 1 would silently DROP rows at runtime (review
+                # r4). Trace-at-1 ambiguity: a literal [:1] slice on the
+                # batch is indistinguishable from [:B] and exports as the
+                # latter. Dims traced >1 cannot be the batch and slice
+                # statically.
+                for dim, sz in enumerate(in_sh):
+                    if sz != 1:
+                        continue
+                    if (starts[dim] == 0 and ends[dim] == 1
+                            and steps[dim] == 1):
+                        ends[dim] = np.iinfo(np.int64).max
+                    else:
+                        raise OnnxExportError(
+                            'slicing a sub-range of a traced-size-1 axis '
+                            f'(dim {dim}) is ambiguous under a dynamic '
+                            'batch — export with a static batch InputSpec '
+                            'instead')
             ins = [self.name_of(eqn.invars[0]),
                    self.add_const(np.asarray(starts, np.int64)),
                    self.add_const(np.asarray(ends, np.int64)),

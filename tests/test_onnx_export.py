@@ -270,3 +270,28 @@ def test_dynamic_batch_slice_passthrough_and_subrange():
         ponnx.export(BatchSliced(), os.path.join(tmp, 'bs'),
                      input_spec=[paddle.static.InputSpec([None, 8],
                                                          'float32')])
+
+
+def test_dynamic_batch_nonbatch_leading_dim_slice():
+    """Review r4 follow-up: after a transpose the leading dim is NOT the
+    batch — a sub-range slice there is fully static and must export (the
+    guard applies only when the traced leading dim is the batch value 1)."""
+    import paddle_tpu.nn as nn
+
+    class SeqMajor(nn.Layer):
+        def forward(self, t):                      # t: [B, 8]
+            s = paddle.transpose(t, [1, 0])        # [8, B] — dim 0 = feature
+            return paddle.transpose(s[:4] * 2.0, [1, 0])   # [B, 4]
+
+    net = SeqMajor()
+    net.eval()
+    tmp = tempfile.mkdtemp()
+    path = ponnx.export(net, os.path.join(tmp, 'sm'),
+                        input_spec=[paddle.static.InputSpec([None, 8],
+                                                            'float32')])
+    blob = open(path, 'rb').read()
+    x = np.random.RandomState(1).rand(5, 8).astype('float32')
+    got = ponnx.reference_run(blob, [x])[0]
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    assert got.shape == (5, 4)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)

@@ -1,43 +1,7 @@
 #!/bin/bash
-# Round-4 tunnel watcher — implements the VERDICT r3 "Next #1/#9" protocol:
-# probe the axon relay every ~2 min; the moment it looks alive run, IN ORDER:
-#   1. bench.py --smoke  (pallas compile smoke, ~1 min — Mosaic regression
-#      surfaces in the first minute of tunnel life)
-#   2. bench.py --fast   (fenced tokens/s + mfu in <5 min) -> BENCH_TPU_LIVE.json
-#      committed to git IMMEDIATELY (the banked number survives anything that
-#      happens to the tunnel afterwards)
-#   3. bench.py          (full profile incl. predictor/eager/decode)
-#      -> BENCH_TPU_FULL.json, committed.
-# The watcher never SIGTERMs a client that holds the chip: every chip-touching
-# stage is a bounded subprocess inside bench.py itself (round-3 lesson: one
-# stray kill wedged the relay for the rest of the session). The outer
-# `timeout`s here are generous last-resort bounds above bench.py's own.
+# Superseded by tools/tpu_watch.py (the TCP relay-state gate this script
+# used reads stale state — the round-4 live session showed `eof-on-connect`
+# while the backend was serving; the python watcher probes with a bounded
+# jax.devices() subprocess instead, and only promotes an improved headline).
 cd /root/repo || exit 1
-LOG=/root/repo/.tpu_watch_r4.log
-banked=0
-for i in $(seq 1 400); do
-  state=$(python bench.py --relay-state 2>/dev/null)
-  echo "$(date +%H:%M:%S) relay=$state" >> "$LOG"
-  if [ "$state" != "eof-on-connect" ] && [[ "$state" != refused* ]] && [[ "$state" != reset* ]]; then
-    echo "$(date +%H:%M:%S) relay promising — running smoke" >> "$LOG"
-    timeout 400 python bench.py --smoke > SMOKE_TPU_LIVE.json 2>>"$LOG"
-    echo "$(date +%H:%M:%S) smoke rc=$? $(cat SMOKE_TPU_LIVE.json)" >> "$LOG"
-    timeout 1500 python bench.py --fast > BENCH_TPU_LIVE.json 2>>"$LOG"
-    rc=$?
-    echo "$(date +%H:%M:%S) fast rc=$rc $(cat BENCH_TPU_LIVE.json)" >> "$LOG"
-    if [ "$rc" -eq 0 ]; then
-      git add BENCH_TPU_LIVE.json SMOKE_TPU_LIVE.json
-      git commit -m "bank live TPU fast-bench result (watcher)" || \
-        { sleep 5; git commit -m "bank live TPU fast-bench result (watcher)"; }
-      banked=1
-      echo "$(date +%H:%M:%S) fast banked — running full bench" >> "$LOG"
-      timeout 3600 python bench.py > BENCH_TPU_FULL.json 2>>"$LOG"
-      echo "$(date +%H:%M:%S) full rc=$? $(cat BENCH_TPU_FULL.json)" >> "$LOG"
-      git add BENCH_TPU_FULL.json
-      git commit -m "bank live TPU full-bench result (watcher)" || true
-      exit 0
-    fi
-  fi
-  sleep 110
-done
-[ "$banked" -eq 1 ] || echo "$(date +%H:%M:%S) watcher expired, nothing banked" >> "$LOG"
+exec python tools/tpu_watch.py >> .tpu_watch_r4.log 2>&1
