@@ -369,12 +369,14 @@ def _banked_live_result():
     return None
 
 
-def _emit_banked(out, note, banked):
+def _emit_banked(out, reason, banked):
+    """``reason`` must state truthfully what failed — 'unreachable' and
+    'probe ok but configs failed' are different diagnoses (the latter can
+    be an on-chip kernel regression, not transport; review r4b)."""
     banked = dict(banked)
     banked['banked'] = True
     banked['note'] = (
-        'backend unreachable at bench time '
-        f'(relay_tcp={out.get("relay_tcp")}; last: {note}); value is the '
+        f'{reason} (relay_tcp={out.get("relay_tcp")}); value is the '
         'on-chip measurement banked earlier this round by the tunnel '
         'watcher (BENCH_TPU_LIVE.json, committed — see TPU_SESSION_NOTES.md '
         'for the fenced run log)')
@@ -411,7 +413,8 @@ def main(fast=False):
         return 1
     banked = _banked_live_result() if probe is None else None
     if banked is not None:
-        _emit_banked(out, note, banked)
+        _emit_banked(out, f'backend unreachable at bench time (last: {note})',
+                     banked)
         return 0
     if probe is None:
         # Last resort: measure on CPU so the round records SOME number and
@@ -519,7 +522,12 @@ def main(fast=False):
     if result is None:
         banked = _banked_live_result() if platform != 'cpu' else None
         if banked is not None:
-            _emit_banked(out, f'all configs failed: {note}', banked)
+            # NOT a transport diagnosis: the probe answered, so this may be
+            # an on-chip kernel/compile regression — say so and carry the
+            # last child error for forensics
+            _emit_banked(out, 'probe succeeded but ALL train configs failed '
+                         f'(possible on-chip regression; last: {note})',
+                         banked)
             return 0
         out['note'] = f'all configs failed; last: {note}'
         print(json.dumps(out))

@@ -120,8 +120,16 @@ class ModelCheckpoint(Callback):
         self.save_dir = save_dir
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+        # reference saves when epoch % save_freq == 0 (epoch 0 included)
+        if self.save_dir and epoch % self.save_freq == 0:
             self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        # reference hapi/callbacks.py: a '<save_dir>/final' checkpoint is
+        # always written at train end — Model.load(save_dir + '/final') is
+        # the documented resume idiom
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, 'final'))
 
 
 class LRScheduler(Callback):

@@ -266,9 +266,16 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        from .callbacks import CallbackList, ProgBarLogger
+        from .callbacks import CallbackList, ModelCheckpoint, ProgBarLogger
         loader = self._as_loader(train_data, batch_size, shuffle)
         eval_loader = self._as_loader(eval_data, batch_size, False)
+        callbacks = list(callbacks or [])
+        if save_dir and not any(isinstance(c, ModelCheckpoint)
+                                for c in callbacks):
+            # reference config_callbacks: save_dir/save_freq delegate to a
+            # ModelCheckpoint — ONE owner of the save schedule (review r4b:
+            # an inline copy here had drifted from the callback's)
+            callbacks.append(ModelCheckpoint(save_freq, save_dir))
         cbks = CallbackList(callbacks, self, verbose=verbose)
         cbks.on_begin('train', {'epochs': epochs,
                                 'steps': len(loader) if hasattr(loader, '__len__') else None,
@@ -302,13 +309,9 @@ class Model:
                 eval_logs = self.evaluate(eval_loader, verbose=0)
                 logs.update({'eval_' + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, str(epoch)))
             if self.stop_training:
                 break
         cbks.on_end('train', logs)
-        if save_dir:
-            self.save(os.path.join(save_dir, 'final'))
 
     def _update_metrics(self, logs, inputs, labels):
         if not self._metrics or not labels:

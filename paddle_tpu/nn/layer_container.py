@@ -20,7 +20,27 @@ class Sequential(Layer):
     def __getitem__(self, idx):
         if isinstance(idx, slice):
             return Sequential(*list(self._sub_layers.values())[idx])
+        if isinstance(idx, str):
+            return self._sub_layers[idx]
         return list(self._sub_layers.values())[idx]
+
+    def __setitem__(self, idx, layer):
+        # the transfer-learning idiom `net[4] = nn.Linear(...)`. An int
+        # replaces by POSITION (resolved to whatever key sits there — keys
+        # drift from positions after named construction or __delitem__;
+        # review r4b), raising IndexError out of range. A str replaces by
+        # key, as the reference's setattr-based __setitem__ does.
+        if isinstance(idx, int):
+            idx = list(self._sub_layers.keys())[idx]
+        self.add_sublayer(idx, layer)
+
+    def __delitem__(self, idx):
+        if isinstance(idx, int):
+            idx = list(self._sub_layers.keys())[idx]
+        del self._sub_layers[idx]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
 
     def __len__(self):
         return len(self._sub_layers)

@@ -206,7 +206,17 @@ class SpectralNorm(Layer):
                 v = v / (jnp.linalg.norm(v) + eps)
                 u = wmat @ v
                 u = u / (jnp.linalg.norm(u) + eps)
+            # u/v are power-iteration STATE, not part of the graph: sigma's
+            # gradient flows only through wmat (reference semantics)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
             sigma = u @ wmat @ v
             out = wt / sigma
-            return out
-        return apply_op(pure, weight)
+            return out, u, v
+        out, u_new, v_new = apply_op(pure, weight)
+        # persist the refined vectors — each forward must CONTINUE the power
+        # iteration, not restart it from the initial random draw (journey
+        # r4b: sigma stayed ~70% off after any number of calls)
+        self.weight_u._replace_value(u_new._value)
+        self.weight_v._replace_value(v_new._value)
+        return out

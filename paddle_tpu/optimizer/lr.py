@@ -23,12 +23,23 @@ class LRScheduler:
     def __call__(self):
         return self.last_lr
 
+    # {checkpoint key: attribute} pairs persisted beyond last_epoch/last_lr
+    # (reference LRScheduler.state_dict's `keys` mechanism — checkpoint key
+    # names follow the reference so its checkpoints cross-load)
+    _extra_state = {}
+
     def state_dict(self):
-        return {'last_epoch': self.last_epoch, 'last_lr': self.last_lr}
+        d = {'last_epoch': self.last_epoch, 'last_lr': self.last_lr}
+        for key, attr in self._extra_state.items():
+            d[key] = getattr(self, attr)
+        return d
 
     def set_state_dict(self, state):
         self.last_epoch = state.get('last_epoch', self.last_epoch)
         self.last_lr = state.get('last_lr', self.last_lr)
+        for key, attr in self._extra_state.items():
+            if key in state:
+                setattr(self, attr, state[key])
 
     set_dict = set_state_dict
 
@@ -186,6 +197,11 @@ class CosineAnnealingDecay(LRScheduler):
 
 
 class ReduceOnPlateau(LRScheduler):
+    # the plateau trackers ARE the schedule — without them a resumed
+    # scheduler forgets how stuck the metric was (journey r4b)
+    _extra_state = {'best': 'best', 'num_bad_epochs': 'num_bad',
+                    'cooldown_counter': 'cooldown_counter'}
+
     def __init__(self, learning_rate, mode='min', factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode='rel', cooldown=0, min_lr=0,
                  epsilon=1e-8, verbose=False):
