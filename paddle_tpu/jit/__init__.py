@@ -329,7 +329,17 @@ def _export_artifacts(infer_fn, infer_fn_functional, pd, bd, specs, examples,
         try:
             exported = jax_export.export(jax.jit(infer_fn_functional))(
                 p_struct, b_struct, *in_specs)
-            blob = exported.serialize()
+            # vjp_order=1 bundles the backward program so jit.load's
+            # TranslatedLayer is FINE-TUNABLE (reference TranslatedLayer is
+            # a trainable Layer); VJP export can fail where the forward
+            # succeeds (e.g. symbolic-shape vjp gaps) — degrade to an
+            # inference-only artifact rather than losing the export
+            try:
+                blob = exported.serialize(vjp_order=1)
+                meta['vjp_exported'] = True
+            except Exception:   # noqa: BLE001 — inference-only fallback
+                blob = exported.serialize()
+                meta['vjp_exported'] = False
         except Exception as e:   # noqa: BLE001 — try next shape mode
             # keep the cause: a silent exported=False cost a round-3
             # debugging session (to_static leaf-count corruption)
@@ -407,7 +417,7 @@ def load_saved_artifacts(path):
     return params, buffers, meta, executable
 
 
-class TranslatedLayer:
+class TranslatedLayer(Layer):
     """A jit.save'd program reloaded WITHOUT its Python class.
 
     Reference: fluid/dygraph/io.py TranslatedLayer (rebuilds a Layer from the
@@ -415,33 +425,86 @@ class TranslatedLayer:
     artifact (.pdexec): deserialization gives a callable XLA program; params
     and buffers come from the .pdparams archive and are passed as the leading
     pytree arguments.
+
+    Like the reference, the result is a real Layer: its parameters are
+    trainable when the artifact was serialized with its backward program
+    (meta['vjp_exported'], the jit.save default) — the deploy-then-finetune
+    workflow. Caveat: the program is traced in eval mode at save time, so
+    dropout stays off and norm running stats stay frozen while fine-tuning
+    (feature-extractor semantics).
     """
 
     def __init__(self, path):
-        self._params, self._buffers, self._meta, self._exec = \
-            load_saved_artifacts(path)
+        super().__init__()
+        params, buffers, self._meta, self._exec = load_saved_artifacts(path)
         if self._exec is None:
             raise RuntimeError(
                 f'{path}.pdexec missing or export failed at save time; '
                 f'reconstruct the Layer and set_state_dict(jit.load raw dict)')
-
-    def forward(self, *inputs):
-        arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(np.asarray(a))
-                  for a in inputs]
-        out = self._exec.call(self._params, self._buffers, *arrays)
-        return jax.tree_util.tree_map(Tensor, out)
-
-    __call__ = forward
-
-    def eval(self):
-        return self
+        from ..nn.layer_base import Parameter
+        # registered under sanitized names ('.' nests in state_dict keys);
+        # _tl_pnames keeps the original program-side names in order
+        self._tl_pnames = list(params)
+        self._tl_bnames = list(buffers)
+        trainable = bool(self._meta.get('vjp_exported'))
+        for n, v in params.items():
+            p = Parameter(v)
+            if not trainable:
+                # no serialized backward program: advertising trainable
+                # params would let a finetune loop run with grads silently
+                # frozen (review r4b)
+                p.stop_gradient = True
+            self.add_parameter(n.replace('.', '__'), p)
+        for n, v in buffers.items():
+            self.register_buffer(n.replace('.', '__'), Tensor(v))
+        self.eval()
 
     def train(self):
-        raise RuntimeError('TranslatedLayer is inference-only '
-                           '(re-train from the original Layer)')
+        if not self._meta.get('vjp_exported'):
+            raise RuntimeError(
+                'this artifact was serialized without its backward program '
+                '(vjp_exported=false) — TranslatedLayer is inference-only; '
+                're-save with the current jit.save to fine-tune')
+        return super().train()
 
-    def state_dict(self):
-        return {**self._params, **self._buffers}
+    def forward(self, *inputs):
+        from ..core.dispatch import apply_op
+        xs = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(np.asarray(a)))
+              for a in inputs]
+        pts = [self._parameters[n.replace('.', '__')] for n in self._tl_pnames]
+        bvals = {n: self._buffers[n.replace('.', '__')]._value
+                 for n in self._tl_bnames}
+        pnames, np_ = self._tl_pnames, len(self._tl_pnames)
+
+        treedef_box = []
+
+        def pure(*leaves):
+            pvals = dict(zip(pnames, leaves[:np_]))
+            out = self._exec.call(pvals, bvals, *leaves[np_:])
+            # arbitrary output pytrees (dict returns etc.) ride through the
+            # dispatch layer as flat leaves and are rebuilt below
+            flat, td = jax.tree_util.tree_flatten(out)
+            treedef_box.append(td)
+            return tuple(flat) if len(flat) != 1 else flat[0]
+
+        if self._meta.get('vjp_exported'):
+            # through the dispatch layer: taped, so loss.backward() reaches
+            # the registered Parameters via the serialized VJP program
+            res = apply_op(pure, *pts, *xs)
+        else:
+            out = pure(*[t._value for t in pts], *[t._value for t in xs])
+            res = jax.tree_util.tree_map(Tensor, out,
+                                         is_leaf=lambda x: not isinstance(
+                                             x, (list, tuple)))
+        flat = list(res) if isinstance(res, (list, tuple)) else [res]
+        return jax.tree_util.tree_unflatten(treedef_box[-1], flat)
+
+    def state_dict(self, *a, **kw):
+        # original program-side names, as the reference TranslatedLayer
+        d = {n: self._parameters[n.replace('.', '__')] for n in self._tl_pnames}
+        d.update({n: self._buffers[n.replace('.', '__')]
+                  for n in self._tl_bnames})
+        return d
 
 
 def load(path, **configs):
