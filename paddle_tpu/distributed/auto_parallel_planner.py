@@ -77,6 +77,13 @@ class _Env:
         spec = tuple(spec)
         if len(spec) != _aval_ndim(var):
             return
+        # broadcasting guard (r4b): elementwise rules propagate specs
+        # across same-rank operands, but a broadcast size-1 dim must not
+        # inherit the partner's axis (it would then flow back through
+        # reshape into e.g. a conv bias)
+        shape = _aval_shape(var)
+        spec = tuple(None if shape[d] == 1 else a
+                     for d, a in enumerate(spec))
         old = self.specs.get(var)
         if old is None:
             self.specs[var] = self._dedup(spec, where, var)
@@ -293,6 +300,31 @@ class _Planner:
                     env.update(eqn.outvars[0],
                                [None if d == dim else a
                                 for d, a in enumerate(s)], where)
+        elif name == 'conv_general_dilated':
+            # vision-model propagation: batch rides lhs->out; the rhs
+            # out-feature dim rides to the out feature dim (channel-sharded
+            # "tensor parallel" convs); spatial dims stay unsharded (halo
+            # exchange is out of planner scope)
+            dn = eqn.params['dimension_numbers']
+            ls, rs = env.get(eqn.invars[0]), env.get(eqn.invars[1])
+            out = [None] * _aval_ndim(eqn.outvars[0])
+            if ls is not None:
+                out[dn.out_spec[0]] = ls[dn.lhs_spec[0]]
+            if rs is not None:
+                out[dn.out_spec[1]] = rs[dn.rhs_spec[0]]
+            if ls is not None or rs is not None:
+                env.update(eqn.outvars[0], out, where)
+        elif name in ('reduce_window_max', 'reduce_window_sum',
+                      'reduce_window_min'):
+            # pooling: rank-preserving; keep axes only on dims the window
+            # does not mix (window size 1 and stride 1)
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                wd = eqn.params['window_dimensions']
+                st = eqn.params['window_strides']
+                env.update(eqn.outvars[0],
+                           [a if wd[d] == 1 and st[d] == 1 else None
+                            for d, a in enumerate(s)], where)
         elif name == 'scan':
             self._scan(eqn, env)
         elif _inner_jaxpr(eqn) is not None:
@@ -394,6 +426,27 @@ class _Planner:
                 out_shape = _aval_shape(eqn.outvars[0])
                 env.update(src,
                            [a if in_shape[d] == out_shape[d] else None
+                            for d, a in enumerate(s)], where)
+        elif name == 'conv_general_dilated':
+            dn = eqn.params['dimension_numbers']
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                l_spec = [None] * _aval_ndim(eqn.invars[0])
+                l_spec[dn.lhs_spec[0]] = s[dn.out_spec[0]]   # batch
+                if any(l_spec):
+                    env.update(eqn.invars[0], l_spec, where)
+                r_spec = [None] * _aval_ndim(eqn.invars[1])
+                r_spec[dn.rhs_spec[0]] = s[dn.out_spec[1]]   # out-feature
+                if any(r_spec):
+                    env.update(eqn.invars[1], r_spec, where)
+        elif name in ('reduce_window_max', 'reduce_window_sum',
+                      'reduce_window_min'):
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                wd = eqn.params['window_dimensions']
+                st = eqn.params['window_strides']
+                env.update(eqn.invars[0],
+                           [a if wd[d] == 1 and st[d] == 1 else None
                             for d, a in enumerate(s)], where)
         elif name == 'scan':
             self._scan(eqn, env)
@@ -603,6 +656,17 @@ def complete_shardings(fn, example_args, seeds, n_iter=8):
     for var, seed in zip(jaxpr.invars, flat_seeds):
         if seed is not None:
             spec = tuple(seed) + (None,) * (_aval_ndim(var) - len(tuple(seed)))
+            shape = _aval_shape(var)
+            for d, a in enumerate(spec):
+                if a is not None and shape[d] == 1:
+                    # the size-1 broadcast guard in _Env.update will drop
+                    # this axis silently — a USER seed deserves a loud
+                    # diagnosis (trace with a real batch, not batch=1)
+                    conflicts.append(
+                        f'seed: axis {a!r} on size-1 dim {d} of arg '
+                        f'{shape} is dropped — completion cannot propagate '
+                        'from a dimension of extent 1; trace with a '
+                        'representative (sharded-size) example instead')
             env.update(var, spec, 'seed')
 
     for _ in range(n_iter):

@@ -325,32 +325,47 @@ def _export_artifacts(infer_fn, infer_fn_functional, pd, bd, specs, examples,
             break
     attempts.append(([jax.ShapeDtypeStruct(e.shape, e.dtype)
                       for e in examples], False))
+    # vjp_order=1 bundles the backward program so jit.load's TranslatedLayer
+    # is FINE-TUNABLE (reference TranslatedLayer is a trainable Layer). VJP
+    # serialization can fail where the forward succeeds (symbolic-shape vjp
+    # gaps), so a LATER shape mode with a working vjp beats an earlier one
+    # without: keep the first inference-only success as fallback and keep
+    # trying shape modes for a trainable artifact (review r4b).
+    fallback = None   # (blob, poly, vjp_error)
+    chosen = None
     for in_specs, poly in attempts:
         try:
             exported = jax_export.export(jax.jit(infer_fn_functional))(
                 p_struct, b_struct, *in_specs)
-            # vjp_order=1 bundles the backward program so jit.load's
-            # TranslatedLayer is FINE-TUNABLE (reference TranslatedLayer is
-            # a trainable Layer); VJP export can fail where the forward
-            # succeeds (e.g. symbolic-shape vjp gaps) — degrade to an
-            # inference-only artifact rather than losing the export
-            try:
-                blob = exported.serialize(vjp_order=1)
-                meta['vjp_exported'] = True
-            except Exception:   # noqa: BLE001 — inference-only fallback
-                blob = exported.serialize()
-                meta['vjp_exported'] = False
         except Exception as e:   # noqa: BLE001 — try next shape mode
             # keep the cause: a silent exported=False cost a round-3
             # debugging session (to_static leaf-count corruption)
             meta['export_error'] = f'{e.__class__.__name__}: {e}'[:300]
             continue
+        try:
+            chosen = (exported.serialize(vjp_order=1), poly, None)
+            break
+        except Exception as e:   # noqa: BLE001 — inference-only candidate
+            if fallback is None:
+                try:
+                    fallback = (exported.serialize(), poly,
+                                f'{e.__class__.__name__}: {e}'[:300])
+                except Exception as e2:   # noqa: BLE001
+                    meta['export_error'] = \
+                        f'{e2.__class__.__name__}: {e2}'[:300]
+    if chosen is None and fallback is not None:
+        chosen = fallback
+    if chosen is not None:
+        blob, poly, vjp_err = chosen
         with open(path + '.pdexec', 'wb') as f:
             f.write(blob)
         meta['exported'] = True
         meta['poly_batch'] = poly
+        meta['vjp_exported'] = vjp_err is None
+        if vjp_err is not None:
+            # tells the user WHY their finetune loop will refuse
+            meta['vjp_export_error'] = vjp_err
         meta.pop('export_error', None)
-        break
     if not meta['exported'] and os.path.exists(path + '.pdexec'):
         os.unlink(path + '.pdexec')   # drop stale program from a prior save
 
@@ -417,6 +432,13 @@ def load_saved_artifacts(path):
     return params, buffers, meta, executable
 
 
+def _flat_name(n):
+    """Injective flattening of dotted program names into single-level
+    attribute names ('_' escaped first, so 'a__weight' and 'a.weight'
+    cannot collide — review r4b)."""
+    return n.replace('_', '_u').replace('.', '_d')
+
+
 class TranslatedLayer(Layer):
     """A jit.save'd program reloaded WITHOUT its Python class.
 
@@ -454,9 +476,9 @@ class TranslatedLayer(Layer):
                 # params would let a finetune loop run with grads silently
                 # frozen (review r4b)
                 p.stop_gradient = True
-            self.add_parameter(n.replace('.', '__'), p)
+            self.add_parameter(_flat_name(n), p)
         for n, v in buffers.items():
-            self.register_buffer(n.replace('.', '__'), Tensor(v))
+            self.register_buffer(_flat_name(n), Tensor(v))
         self.eval()
 
     def train(self):
@@ -471,8 +493,8 @@ class TranslatedLayer(Layer):
         from ..core.dispatch import apply_op
         xs = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(np.asarray(a)))
               for a in inputs]
-        pts = [self._parameters[n.replace('.', '__')] for n in self._tl_pnames]
-        bvals = {n: self._buffers[n.replace('.', '__')]._value
+        pts = [self._parameters[_flat_name(n)] for n in self._tl_pnames]
+        bvals = {n: self._buffers[_flat_name(n)]._value
                  for n in self._tl_bnames}
         pnames, np_ = self._tl_pnames, len(self._tl_pnames)
 
@@ -499,11 +521,15 @@ class TranslatedLayer(Layer):
         flat = list(res) if isinstance(res, (list, tuple)) else [res]
         return jax.tree_util.tree_unflatten(treedef_box[-1], flat)
 
-    def state_dict(self, *a, **kw):
-        # original program-side names, as the reference TranslatedLayer
-        d = {n: self._parameters[n.replace('.', '__')] for n in self._tl_pnames}
-        d.update({n: self._buffers[n.replace('.', '__')]
-                  for n in self._tl_bnames})
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=''):
+        # original program-side (dotted) names, as the reference
+        # TranslatedLayer; honors the Layer API's destination/prefix
+        d = destination if destination is not None else {}
+        for n in self._tl_pnames:
+            d[structured_name_prefix + n] = self._parameters[_flat_name(n)]
+        for n in self._tl_bnames:
+            d[structured_name_prefix + n] = self._buffers[_flat_name(n)]
         return d
 
 

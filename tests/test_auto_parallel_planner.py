@@ -210,3 +210,63 @@ def test_train_step_completion_including_optimizer_state():
     assert norm(st['blocks']['qkv_w']['moment1']) == (None, None, 'mp')
     assert norm(st['blocks']['fc_w']['moment2']) == (None, None, 'mp')
     assert norm(st['blocks']['proj_w']['moment1']) == (None, 'mp')
+
+
+def test_cnn_dp_completion_and_apply():
+    """Vision-model completion (r4b): seeding ONLY the input batch dim with
+    'dp' must ride through conv/pool/flatten/dense to the loss, park the
+    weights unsharded, and the planned step must run on the mesh."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.layer_base import functional_call
+
+    paddle.seed(30)
+
+    class CNN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2D(3, 8, 3, padding=1)
+            self.c2 = nn.Conv2D(8, 16, 3, padding=1, stride=2)
+            self.fc = nn.Linear(16 * 4 * 4, 10)
+
+        def forward(self, x):
+            x = F.relu(self.c1(x))
+            x = F.relu(self.c2(x))
+            return self.fc(x.flatten(1))
+
+    net = CNN()
+    pd = {n: p._value for n, p in net.named_parameters()}
+    bd = {}
+
+    def loss_fn(pd, x, y):
+        out, _ = functional_call(net, pd, bd, paddle.Tensor(x))
+        logits = getattr(out, '_value', out)
+        oh = jax.nn.one_hot(y, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * oh, -1))
+
+    x = jnp.zeros((8, 3, 8, 8), jnp.float32)
+    y = jnp.zeros((8,), jnp.int32)
+    seeds_p = {n: None for n in pd}
+    plan = complete_shardings(loss_fn, (pd, x, y),
+                              (seeds_p, P('dp', None, None, None), P('dp')))
+
+    def norm(s):
+        t = tuple(s)
+        while t and t[-1] is None:
+            t = t[:-1]
+        return t
+
+    # weights remain replicated; batch stays on the data
+    for n, s in plan.arg_specs[0].items():
+        assert norm(s) == (), f'{n} unexpectedly sharded: {s}'
+    assert norm(plan.arg_specs[1]) == ('dp',)
+
+    # the planned function runs under the mesh with those shardings
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    with Mesh(devs, ('dp',)) as mesh:
+        step = plan.apply(loss_fn, mesh)
+        args = plan.place((pd, x, y), mesh)
+        out = step(*args)
+    assert np.isfinite(float(out))

@@ -548,3 +548,62 @@ def test_jit_save_load_dict_output_journey(tmp_path):
     loss.backward()
     g = loaded.parameters()[0].grad
     assert g is not None
+
+
+def test_vision_quickstart_journey():
+    """The 2.1 quickstart: MNIST + Compose(ToTensor, Normalize) + LeNet +
+    hapi Model.fit/evaluate/predict_batch (synthetic MNIST fallback)."""
+    from paddle_tpu.vision import transforms, datasets
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(26)
+
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(mean=[0.5], std=[0.5])])
+    train = datasets.MNIST(mode='train', transform=tf, backend='cv2')
+    x0, _ = train[0]
+    assert np.asarray(x0).shape == (1, 28, 28)
+    net = LeNet()
+    m = Model(net)
+    m.prepare(paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-3),
+              nn.CrossEntropyLoss(), Accuracy())
+    loader = paddle.io.DataLoader(train, batch_size=16, shuffle=True)
+    m.fit(loader, epochs=1, verbose=0, num_iters=4)
+    res = m.evaluate(loader, verbose=0, num_iters=2)
+    assert 'acc' in res and 'loss' in res
+    pred = m.predict_batch(
+        [np.stack([np.asarray(train[i][0]) for i in range(4)])])
+    assert np.asarray(pred[0]).shape == (4, 10)
+
+
+def test_jit_load_name_collision_roundtrip(tmp_path):
+    """Review r4b: program-side names 'a__weight' and 'a.weight' must NOT
+    alias after jit.load's attribute-name flattening."""
+    paddle.seed(27)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            from paddle_tpu.nn.layer_base import Parameter
+            self.a = nn.Linear(4, 4)
+            self.add_parameter('a__weight', Parameter(
+                paddle.ones([4])._value * 3.0))
+
+        def forward(self, x):
+            return self.a(x) * self.a__weight
+
+    net = Net()
+    p = str(tmp_path / 'c')
+    paddle.jit.save(net, p,
+                    input_spec=[paddle.static.InputSpec([None, 4],
+                                                        'float32')])
+    loaded = paddle.jit.load(p)
+    assert len(loaded.parameters()) == len(net.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(28).rand(2, 4).astype('float32'))
+    np.testing.assert_allclose(np.asarray(loaded(x)._value),
+                               np.asarray(net(x)._value), atol=1e-5)
+    sd = loaded.state_dict(structured_name_prefix='m.')
+    assert 'm.a__weight' in sd and 'm.a.weight' in sd
