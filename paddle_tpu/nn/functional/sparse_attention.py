@@ -9,10 +9,13 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import op
+from ...core import dispatch
+from ...core.dispatch import apply_op
+from ...tensor.random import next_key
 
 
-def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
+              rng=None):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum('...qhd,...khd->...hqk', q, k) * scale
@@ -30,22 +33,52 @@ def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
         else:
             scores = scores + mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p and rng is not None:
+        # inverted dropout on the attention probabilities (reference
+        # fused_attention semantics)
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum('...hqk,...khd->...qhd', probs, v)
 
 
-@op
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
-    """query/key/value: [batch, seq, heads, head_dim] (paddle layout)."""
+    """query/key/value: [batch, seq, heads, head_dim] (paddle layout).
+
+    Attention dropout (dropout_p > 0 while training) routes to the XLA
+    path — the pallas flash kernels do not sample dropout, and silently
+    skipping it would train a different model than the user asked for
+    (journey r4b: dropout_p was previously accepted and IGNORED)."""
+    hook = dispatch.amp_cast_hook
+    if hook is not None:
+        query, key, value = hook('scaled_dot_product_attention',
+                                 [query, key, value])
+    drop = float(dropout_p or 0.0) if training else 0.0
+    # the availability probe and both compute paths see RAW arrays; the
+    # Tensor wrappers stay outside apply_op so the tape records the op
+    # (review r4b: handing Tensors to flash_attention crashes on TPU)
+    qv, kv, vv = (getattr(t, '_value', t) for t in (query, key, value))
+    mv = getattr(attn_mask, '_value', attn_mask)
     use_flash = False
-    try:
-        from ...ops.flash_attention import flash_attention_available
-        use_flash = flash_attention_available(query, key, value, attn_mask)
-    except Exception:
-        use_flash = False
-    if use_flash:
-        from ...ops.flash_attention import flash_attention
-        return flash_attention(query, key, value, causal=is_causal,
-                               mask=attn_mask)
-    return _sdpa_xla(query, key, value, mask=attn_mask, causal=is_causal)
+    if drop == 0.0:
+        try:
+            from ...ops.flash_attention import flash_attention_available
+            use_flash = flash_attention_available(qv, kv, vv, mv)
+        except Exception:
+            use_flash = False
+    # the key is drawn OUTSIDE apply_op so the tape's vjp replay sees the
+    # same mask the forward sampled (the F.dropout pattern)
+    rng = next_key() if drop else None
+
+    def pure(q, k, v, *m):
+        mask = m[0] if m else None
+        if use_flash:
+            from ...ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=is_causal, mask=mask)
+        return _sdpa_xla(q, k, v, mask=mask, causal=is_causal,
+                         dropout_p=drop, rng=rng)
+
+    args = ((query, key, value)
+            + (() if attn_mask is None else (attn_mask,)))
+    return apply_op(pure, *args)

@@ -607,3 +607,53 @@ def test_jit_load_name_collision_roundtrip(tmp_path):
                                np.asarray(net(x)._value), atol=1e-5)
     sd = loaded.state_dict(structured_name_prefix='m.')
     assert 'm.a__weight' in sd and 'm.a.weight' in sd
+
+
+def test_attention_dropout_actually_applied():
+    """Journey r4b: MultiHeadAttention(dropout=0.3) in train mode must
+    actually sample attention dropout (it was silently ignored), keep the
+    inverted-dropout expectation, share the mask between forward and
+    backward, and turn OFF in eval mode."""
+    paddle.seed(31)
+    import paddle_tpu.nn.functional as F2
+    rs = np.random.RandomState(32)
+    q = paddle.to_tensor(rs.rand(2, 8, 2, 16).astype('float32'))
+
+    def run(training):
+        paddle.seed(99)
+        return np.asarray(F2.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.5, training=training)._value)
+
+    a, b = run(True), run(True)
+    np.testing.assert_allclose(a, b, atol=0)      # same seed -> same mask
+    paddle.seed(99)
+    c = np.asarray(F2.scaled_dot_product_attention(
+        q, q, q, dropout_p=0.5, training=False)._value)
+    assert not np.allclose(a, c), 'dropout had no effect in train mode'
+    d = np.asarray(F2.scaled_dot_product_attention(
+        q, q, q, dropout_p=0.0, training=True)._value)
+    np.testing.assert_allclose(c, d, atol=1e-6)   # eval == p0
+
+    # backward shares the forward's mask: grad of sum wrt q is finite and
+    # reproducible under the same seed
+    def gradrun():
+        paddle.seed(7)
+        qq = paddle.to_tensor(rs.rand(2, 8, 2, 16).astype('float32') * 0
+                              + np.asarray(q._value))
+        qq.stop_gradient = False
+        out = F2.scaled_dot_product_attention(qq, qq, qq, dropout_p=0.5,
+                                              training=True)
+        out.sum().backward()
+        return np.asarray(qq.grad)
+
+    g1, g2 = gradrun(), gradrun()
+    np.testing.assert_allclose(g1, g2, atol=0)
+
+    mha = nn.MultiHeadAttention(32, 2, dropout=0.5)
+    x = paddle.to_tensor(rs.rand(2, 8, 32).astype('float32'))
+    paddle.seed(5)
+    o1 = np.asarray(mha(x)._value)
+    paddle.seed(5)
+    mha.eval()
+    o2 = np.asarray(mha(x)._value)
+    assert not np.allclose(o1, o2), 'MHA train-mode dropout inert'
