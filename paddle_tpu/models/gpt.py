@@ -88,6 +88,11 @@ class GPTConfig:
     # context the cache, not the weights, is the decode step's biggest HBM
     # stream (ops/weight_only.quantize_kv; int8 flash decode kernel)
     kv_cache_int8: bool = False
+    # lax.scan unroll over the layer stack (single-chip path): >1 lets XLA
+    # software-pipeline across layer boundaries at the cost of program
+    # size. Numerics are unchanged (tested); throughput is a chip-side
+    # tuning knob (tools/tpu_tune.py --round3 rung).
+    scan_unroll: int = 1
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
@@ -278,7 +283,8 @@ def forward_hidden(params, tokens, config: GPTConfig):
     def scan_body(carry, bp):
         return body(bp, carry), None
 
-    x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+    x, _ = jax.lax.scan(scan_body, x, params['blocks'],
+                        unroll=max(1, int(config.scan_unroll)))
     return _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
 
 

@@ -34,6 +34,10 @@ def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
             scores = scores + mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
     if dropout_p and rng is not None:
+        if dropout_p >= 1.0:
+            # everything dropped: zeros with zero grads (the 1/(1-p)
+            # rescale would be inf and leak NaN through where's vjp)
+            return jnp.zeros(q.shape, q.dtype)
         # inverted dropout on the attention probabilities (reference
         # fused_attention semantics)
         keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)

@@ -34,7 +34,8 @@ def child(cfg):
     gcfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
                          num_heads=16, max_seq_len=seq, dtype='bfloat16',
                          remat=cfg['remat'], use_flash=cfg['flash'],
-                         remat_policy=cfg.get('policy', 'full'))
+                         remat_policy=cfg.get('policy', 'full'),
+                         scan_unroll=cfg.get('unroll', 1))
     params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
     opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
@@ -100,6 +101,12 @@ def main():
                  policy='dots'),
             dict(batch=16, seq=1024, flash=True, remat=True, bq=512, bk=512,
                  policy='dots'),
+        ]
+    if '--round3' in sys.argv:
+        # scan-unroll rung at the r4 winning config (512-blocks + dots)
+        variants = [
+            dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=512,
+                 policy='dots', unroll=u) for u in (1, 2, 4)
         ]
     if quick:
         variants = variants[:3]
