@@ -3,6 +3,8 @@ one_hot, cosine_similarity, pixel_shuffle, unfold.
 
 Reference: python/paddle/nn/functional/common.py, input.py, vision.py.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -157,7 +159,58 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return jnp.reshape(patches, (n, c * kh * kw, oh * ow))
 
 
-@op
+@functools.lru_cache(maxsize=256)
+def _nearest_index(n_in, n_out):
+    """Gather indices for the reference nearest rule src = floor(i*in/out)
+    (jax.image's half-pixel rounding picks different pixels when
+    DOWNSAMPLING). Plain trace-time numpy — NOT a dispatched op."""
+    import numpy as np
+    return jnp.asarray(np.minimum(
+        (np.arange(n_out) * n_in / n_out).astype(np.int64), n_in - 1))
+
+
+@functools.lru_cache(maxsize=256)
+def _resize_weights(n_in, n_out, align_corners, kind):
+    """[n_out, n_in] f32 interpolation weight matrix (trace-time numpy).
+
+    linear/cubic: source coords per the alignment rule (align_corners=True:
+    i*(in-1)/(out-1); else half-pixel), edge-replicated taps; cubic uses
+    the reference convention a=-0.75 (OpenCV/Paddle — jax.image uses -0.5,
+    which is why resize couldn't serve bicubic). area: adaptive-avg-pool
+    bins — integer [floor(i*in/out), ceil((i+1)*in/out)) spans averaged
+    UNWEIGHTED (reference 'area' semantics)."""
+    import numpy as np
+    i = np.arange(n_out, dtype=np.float64)
+    W = np.zeros((n_out, n_in), np.float64)
+    if kind == 'area':
+        for o in range(n_out):
+            a = int(np.floor(o * n_in / n_out))
+            b = int(np.ceil((o + 1) * n_in / n_out))
+            W[o, a:b] = 1.0 / (b - a)
+        return jnp.asarray(W, jnp.float32)
+    if align_corners:
+        src = i * ((n_in - 1) / (n_out - 1)) if n_out > 1 else np.zeros(1)
+    else:
+        src = (i + 0.5) * (n_in / n_out) - 0.5
+    s0 = np.floor(src).astype(np.int64)
+    frac = src - s0
+    io = np.arange(n_out)
+    if kind == 'linear':
+        taps = ((0, 1.0 - frac), (1, frac))
+    else:
+        a = -0.75
+
+        def cub(t):
+            t = np.abs(t)
+            return np.where(
+                t <= 1, ((a + 2) * t - (a + 3)) * t * t + 1,
+                np.where(t < 2, a * (((t - 5) * t + 8) * t - 4), 0.0))
+        taps = tuple((k, cub(frac - k)) for k in (-1, 0, 1, 2))
+    for k, wk in taps:
+        np.add.at(W, (io, np.clip(s0 + k, 0, n_in - 1)), wk)
+    return jnp.asarray(W, jnp.float32)
+
+
 def interpolate(x, size=None, scale_factor=None, mode='nearest',
                 align_corners=False, align_mode=0, data_format='NCHW', name=None):
     if data_format in ('NCHW', 'NCW', 'NCDHW'):
@@ -178,11 +231,44 @@ def interpolate(x, size=None, scale_factor=None, mode='nearest',
         out_shape = tuple(x.shape[:2]) + tuple(size)
     else:
         out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
-    method = {'nearest': 'nearest', 'bilinear': 'bilinear', 'trilinear': 'trilinear',
-              'bicubic': 'bicubic', 'linear': 'linear', 'area': 'linear'}[mode]
-    if method == 'trilinear':
-        method = 'linear'
-    return jax.image.resize(x, out_shape, method=method)
+    linear_family = mode in ('linear', 'bilinear', 'trilinear')
+    if linear_family and not align_corners:
+        # jax.image.resize IS the reference semantics here (half-pixel
+        # centers) — verified element-exact. Through apply_op: resize's
+        # internal jit rejects Tensor wrappers at abstractification.
+        # antialias=False: the reference samples pointwise at half-pixel
+        # coords even when downsampling (jax antialiases by default)
+        return apply_op(
+            lambda v: jax.image.resize(v, out_shape, method='linear',
+                                       antialias=False), x)
+    # nearest (reference floor rule — jax rounds from half-pixel centers,
+    # differing on downsample), align_corners=True, bicubic (reference
+    # cubic kernel a=-0.75, not jax.image's a=-0.5), and area (adaptive
+    # average pooling semantics) go through exact per-axis weight matrices
+    # (sizes are static): out = W_axis @ x along each spatial axis.
+    kind = {'nearest': 'nearest', 'linear': 'linear', 'bilinear': 'linear',
+            'trilinear': 'linear', 'bicubic': 'cubic', 'area': 'area'}[mode]
+    first_spatial = 2 if chan_first else 1
+
+    def pure(v):
+        out = v
+        for ax_i, (n_in, n_out) in enumerate(zip(spatial, size)):
+            axis = first_spatial + ax_i
+            if n_in == n_out:
+                continue
+            if kind == 'nearest':
+                # gather: O(n_out) and dtype-preserving (int label maps)
+                out = jnp.take(out, _nearest_index(n_in, n_out), axis=axis)
+                continue
+            w = _resize_weights(n_in, n_out, align_corners, kind)
+            out = jnp.moveaxis(
+                jnp.tensordot(w, jnp.moveaxis(out, axis, 0).astype(
+                    jnp.float32), axes=1), 0, axis)
+        # weighted kinds compute in f32; hand back the input dtype so AMP
+        # models don't silently upcast (and mode choice never changes the
+        # output dtype)
+        return out.astype(v.dtype)
+    return apply_op(pure, x)
 
 
 def upsample(x, size=None, scale_factor=None, mode='nearest',

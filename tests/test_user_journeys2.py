@@ -748,3 +748,31 @@ def test_fleet_zero2_amp_clip_journey():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_interpolate_mode_parity():
+    """Journey r4b: align_corners=True, bicubic (a=-0.75 kernel), and
+    'area' (adaptive-pool bins) previously diverged from the reference
+    semantics; all modes now match the torch/paddle conventions."""
+    torch = pytest.importorskip('torch')
+    import torch.nn.functional as TF
+    import paddle_tpu.nn.functional as F2
+
+    x = np.random.RandomState(0).rand(2, 3, 5, 7).astype('float32')
+    cases = [('nearest', None), ('bilinear', False), ('bilinear', True),
+             ('bicubic', False), ('bicubic', True), ('area', None)]
+    for size in ([10, 14], [3, 4]):
+        for mode, ac in cases:
+            kw = {} if ac is None else {'align_corners': ac}
+            ours = np.asarray(F2.interpolate(paddle.to_tensor(x), size=size,
+                                             mode=mode, **kw)._value)
+            theirs = TF.interpolate(torch.from_numpy(x), size=tuple(size),
+                                    mode=mode, **kw).numpy()
+            np.testing.assert_allclose(ours, theirs, atol=2e-6,
+                                       err_msg=f'{mode} ac={ac} {size}')
+    # grads flow through the weight-matrix path
+    xp = paddle.to_tensor(x)
+    xp.stop_gradient = False
+    F2.interpolate(xp, size=[10, 14], mode='bicubic',
+                   align_corners=True).sum().backward()
+    assert np.isfinite(np.asarray(xp.grad)).all()
