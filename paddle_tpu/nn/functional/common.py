@@ -169,32 +169,42 @@ def _nearest_index(n_in, n_out):
         (np.arange(n_out) * n_in / n_out).astype(np.int64), n_in - 1))
 
 
-@functools.lru_cache(maxsize=256)
-def _resize_weights(n_in, n_out, align_corners, kind):
-    """[n_out, n_in] f32 interpolation weight matrix (trace-time numpy).
+@functools.lru_cache(maxsize=64)
+def _area_weights(n_in, n_out):
+    """[n_out, n_in] f32 matrix of adaptive-avg-pool bins — integer
+    [floor(i*in/out), ceil((i+1)*in/out)) spans averaged UNWEIGHTED
+    (reference 'area' semantics). Bins vary in width, so a matrix is the
+    natural form; area sizes are small in practice."""
+    import numpy as np
+    W = np.zeros((n_out, n_in), np.float64)
+    for o in range(n_out):
+        a = int(np.floor(o * n_in / n_out))
+        b = int(np.ceil((o + 1) * n_in / n_out))
+        W[o, a:b] = 1.0 / (b - a)
+    return jnp.asarray(W, jnp.float32)
 
-    linear/cubic: source coords per the alignment rule (align_corners=True:
-    i*(in-1)/(out-1); else half-pixel), edge-replicated taps; cubic uses
-    the reference convention a=-0.75 (OpenCV/Paddle — jax.image uses -0.5,
-    which is why resize couldn't serve bicubic). area: adaptive-avg-pool
-    bins — integer [floor(i*in/out), ceil((i+1)*in/out)) spans averaged
-    UNWEIGHTED (reference 'area' semantics)."""
+
+@functools.lru_cache(maxsize=256)
+def _resize_taps(n_in, n_out, align_corners, align_mode, kind):
+    """(indices, weights) tap lists, each [n_out], for gather + weighted
+    sum (O(taps) per output — a dense matrix wastes O(n_in/taps)x FLOPs
+    and pins big device arrays in the cache; review r4b).
+
+    Source coords per the alignment rule: align_corners=True ->
+    i*(in-1)/(out-1); align_mode=1 (the PaddleDetection convention) ->
+    i*in/out; else half-pixel. Edge-replicated taps; cubic uses the
+    reference convention a=-0.75 (jax.image uses -0.5, which is why
+    resize couldn't serve bicubic)."""
     import numpy as np
     i = np.arange(n_out, dtype=np.float64)
-    W = np.zeros((n_out, n_in), np.float64)
-    if kind == 'area':
-        for o in range(n_out):
-            a = int(np.floor(o * n_in / n_out))
-            b = int(np.ceil((o + 1) * n_in / n_out))
-            W[o, a:b] = 1.0 / (b - a)
-        return jnp.asarray(W, jnp.float32)
     if align_corners:
         src = i * ((n_in - 1) / (n_out - 1)) if n_out > 1 else np.zeros(1)
+    elif align_mode == 1:
+        src = i * (n_in / n_out)
     else:
         src = (i + 0.5) * (n_in / n_out) - 0.5
     s0 = np.floor(src).astype(np.int64)
     frac = src - s0
-    io = np.arange(n_out)
     if kind == 'linear':
         taps = ((0, 1.0 - frac), (1, frac))
     else:
@@ -206,9 +216,9 @@ def _resize_weights(n_in, n_out, align_corners, kind):
                 t <= 1, ((a + 2) * t - (a + 3)) * t * t + 1,
                 np.where(t < 2, a * (((t - 5) * t + 8) * t - 4), 0.0))
         taps = tuple((k, cub(frac - k)) for k in (-1, 0, 1, 2))
-    for k, wk in taps:
-        np.add.at(W, (io, np.clip(s0 + k, 0, n_in - 1)), wk)
-    return jnp.asarray(W, jnp.float32)
+    idxs = tuple(jnp.asarray(np.clip(s0 + k, 0, n_in - 1)) for k, _ in taps)
+    wts = tuple(jnp.asarray(w, jnp.float32) for _, w in taps)
+    return idxs, wts
 
 
 def interpolate(x, size=None, scale_factor=None, mode='nearest',
@@ -232,7 +242,7 @@ def interpolate(x, size=None, scale_factor=None, mode='nearest',
     else:
         out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
     linear_family = mode in ('linear', 'bilinear', 'trilinear')
-    if linear_family and not align_corners:
+    if linear_family and not align_corners and align_mode == 0:
         # jax.image.resize IS the reference semantics here (half-pixel
         # centers) — verified element-exact. Through apply_op: resize's
         # internal jit rejects Tensor wrappers at abstractification.
@@ -242,12 +252,14 @@ def interpolate(x, size=None, scale_factor=None, mode='nearest',
             lambda v: jax.image.resize(v, out_shape, method='linear',
                                        antialias=False), x)
     # nearest (reference floor rule — jax rounds from half-pixel centers,
-    # differing on downsample), align_corners=True, bicubic (reference
-    # cubic kernel a=-0.75, not jax.image's a=-0.5), and area (adaptive
-    # average pooling semantics) go through exact per-axis weight matrices
-    # (sizes are static): out = W_axis @ x along each spatial axis.
+    # differing on downsample), align_corners=True, align_mode=1 (src =
+    # i*in/out — the PaddleDetection convention), bicubic (reference cubic
+    # kernel a=-0.75, not jax.image's a=-0.5), and area (adaptive average
+    # pooling semantics) go through exact per-axis tap gathers / bin
+    # matrices (sizes are static).
     kind = {'nearest': 'nearest', 'linear': 'linear', 'bilinear': 'linear',
             'trilinear': 'linear', 'bicubic': 'cubic', 'area': 'area'}[mode]
+    amode = align_mode if (kind == 'linear' and not align_corners) else 0
     first_spatial = 2 if chan_first else 1
 
     def pure(v):
@@ -260,10 +272,18 @@ def interpolate(x, size=None, scale_factor=None, mode='nearest',
                 # gather: O(n_out) and dtype-preserving (int label maps)
                 out = jnp.take(out, _nearest_index(n_in, n_out), axis=axis)
                 continue
-            w = _resize_weights(n_in, n_out, align_corners, kind)
-            out = jnp.moveaxis(
-                jnp.tensordot(w, jnp.moveaxis(out, axis, 0).astype(
-                    jnp.float32), axes=1), 0, axis)
+            if kind == 'area':
+                w = _area_weights(n_in, n_out)
+                out = jnp.moveaxis(
+                    jnp.tensordot(w, jnp.moveaxis(out, axis, 0).astype(
+                        jnp.float32), axes=1), 0, axis)
+                continue
+            idxs, wts = _resize_taps(n_in, n_out, align_corners, amode, kind)
+            moved = jnp.moveaxis(out, axis, 0).astype(jnp.float32)
+            bshape = (n_out,) + (1,) * (moved.ndim - 1)
+            acc = sum(w.reshape(bshape) * jnp.take(moved, ix, axis=0)
+                      for ix, w in zip(idxs, wts))
+            out = jnp.moveaxis(acc, 0, axis)
         # weighted kinds compute in f32; hand back the input dtype so AMP
         # models don't silently upcast (and mode choice never changes the
         # output dtype)

@@ -87,7 +87,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(i for i in range(v.ndim) if i != (chan_axis % v.ndim))
         if use_batch_stats:
             mean = jnp.mean(v, axis=axes)
-            var = jnp.mean(jnp.square(v), axis=axes) - jnp.square(mean)
+            # two-pass variance: the one-pass E[x^2]-mean^2 form goes
+            # NEGATIVE under f32 cancellation when a channel is
+            # near-constant with a large mean (true var ~1e-6 computed as
+            # -1.5e-5 < -eps) -> rsqrt(negative) NaN'd a real ResNet run
+            # (journey r4b, deterministic replay in the regression test)
+            shape_m = [1] * v.ndim
+            shape_m[chan_axis % v.ndim] = v.shape[chan_axis % v.ndim]
+            var = jnp.mean(jnp.square(v - jnp.reshape(mean, shape_m)),
+                           axis=axes)
             if mesh_axis is not None:
                 try:
                     # global var = pmean(E_local[x^2]) - gmean^2; the
@@ -95,7 +103,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                     # mean here would drop the between-shard variance)
                     ex2 = jax.lax.pmean(var + jnp.square(mean), mesh_axis)
                     mean = jax.lax.pmean(mean, mesh_axis)
-                    var = ex2 - jnp.square(mean)
+                    # the cross-replica merge needs the E[x^2] form; clamp
+                    # the same cancellation hazard out of it
+                    var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
                 except NameError:
                     bound = {}
                     try:

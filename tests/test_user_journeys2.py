@@ -776,3 +776,26 @@ def test_interpolate_mode_parity():
     F2.interpolate(xp, size=[10, 14], mode='bicubic',
                    align_corners=True).sum().backward()
     assert np.isfinite(np.asarray(xp.grad)).all()
+
+
+def test_batchnorm_near_constant_channel_no_nan():
+    """Journey r4b (deterministic replay of a real ResNet-18 NaN): a
+    channel that is near-constant with a large mean makes the one-pass
+    E[x^2]-mean^2 variance NEGATIVE under f32 cancellation (true var
+    ~1e-6 computed as -1.5e-5, beating eps=1e-5) -> rsqrt(neg) = NaN.
+    The two-pass form must stay finite, forward and backward."""
+    bn = nn.BatchNorm2D(2)
+    rs = np.random.RandomState(0)
+    # channel 0: large mean, tiny spread (the killer); channel 1: normal
+    c0 = 80.0 + rs.rand(2, 1, 4, 4).astype('float32') * 3e-3
+    c1 = rs.rand(2, 1, 4, 4).astype('float32')
+    x = paddle.to_tensor(np.concatenate([c0, c1], axis=1))
+    x.stop_gradient = False
+    out = bn(x)
+    a = np.asarray(out._value)
+    assert np.isfinite(a).all(), 'BN forward NaN on near-constant channel'
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad)).all()
+    # and the running stats stayed finite/sane
+    assert np.isfinite(np.asarray(bn._variance._value)).all()
+    assert (np.asarray(bn._variance._value) >= 0).all()
