@@ -1,6 +1,6 @@
 """Autoregressive text generation with the KV-cache decode path.
 
-python examples/generate_gpt.py --tokens 64 --temperature 0.8 --top-k 40
+python examples/generate_gpt.py --tokens 64 --temperature 0.8 --top-k 40 --top-p 0.95
 
 Loads (or initializes) a GPT checkpoint, prefills the prompt once, then
 decodes through ONE compiled single-token step (donated cache buffers) —
@@ -31,6 +31,8 @@ def main():
     p.add_argument('--tokens', type=int, default=64)
     p.add_argument('--temperature', type=float, default=0.8)
     p.add_argument('--top-k', type=int, default=40)
+    p.add_argument('--top-p', type=float, default=None,
+                   help='nucleus sampling threshold (e.g. 0.95)')
     p.add_argument('--batch', type=int, default=1)
     p.add_argument('--hidden', type=int, default=256)
     p.add_argument('--layers', type=int, default=4)
@@ -61,7 +63,8 @@ def main():
     model.generate(prompt, max_new_tokens=2, temperature=0)
     t0 = time.perf_counter()
     out = model.generate(prompt, max_new_tokens=args.tokens,
-                         temperature=args.temperature, top_k=args.top_k)
+                         temperature=args.temperature, top_k=args.top_k,
+                         top_p=args.top_p)
     toks = out.numpy()                       # host read fences the chain
     dt = time.perf_counter() - t0
     print(f'generated {args.batch}x{args.tokens} tokens in {dt:.2f}s '

@@ -456,19 +456,37 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     return logits, {'k': k_new, 'v': v_new}
 
 
-def _sample(logits, temperature, top_k, key=None):
-    """Greedy / temperature / top-k next-token draw — the ONE sampling rule
-    shared by the cache path and the sliding-window continuation. ``key``
-    overrides the global PRNG stream (reproducible functional sampling)."""
+def _sample(logits, temperature, top_k, top_p=None, key=None):
+    """Greedy / temperature / top-k / nucleus next-token draw — the ONE
+    sampling rule shared by the cache path and the sliding-window
+    continuation. ``key`` overrides the global PRNG stream (reproducible
+    functional sampling). top_k and top_p compose (intersection), as in
+    the reference generation utilities."""
     if temperature == 0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if key is None:
         from ..tensor.random import next_key
         key = next_key()
     lg = logits.astype(jnp.float32) / temperature
-    if top_k:
-        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    nucleus = top_p is not None and top_p < 1.0
+    if top_k or nucleus:
+        # ONE descending sort serves both filters (per-token decode path)
+        srt = jnp.sort(lg, axis=-1)[:, ::-1]
+        if top_k:
+            kth = srt[:, top_k - 1][:, None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+            srt = jnp.where(jnp.arange(srt.shape[-1]) < top_k, srt, -jnp.inf)
+        if nucleus:
+            # keep the smallest prefix of the sorted (already top_k-masked)
+            # distribution whose cumulative prob reaches top_p; the argmax
+            # is ALWAYS kept (exclusive cumsum + explicit index-0 set, so
+            # top_p <= 0 degrades to greedy, not to all -inf)
+            probs = jax.nn.softmax(srt, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            keep = keep.at[:, 0].set(True)
+            cut = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                          keepdims=True)
+            lg = jnp.where(lg < cut, -jnp.inf, lg)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
@@ -729,7 +747,8 @@ class GPTForCausalLM(Layer):
             return forward(params, jnp.asarray(tok).astype(jnp.int32), cfg)
         return apply_op(pure, tokens, *plist)
 
-    def generate(self, tokens, max_new_tokens=32, temperature=1.0, top_k=None):
+    def generate(self, tokens, max_new_tokens=32, temperature=1.0,
+                 top_k=None, top_p=None):
         """KV-cache autoregressive sampling: one compiled prefill + one
         compiled single-token decode step (O(S_max d) per token, no
         per-length retracing — see make_decode_fns). Tokens past the
@@ -751,7 +770,7 @@ class GPTForCausalLM(Layer):
             logits, cache = prefill(params, toks, cache)
             out = [toks]
             for i in range(n_cached):
-                nxt = _sample(logits, temperature, top_k)
+                nxt = _sample(logits, temperature, top_k, top_p)
                 out.append(nxt[:, None])
                 if i + 1 < n_cached:
                     logits, cache = step(params, nxt, jnp.int32(T0 + i),
@@ -759,7 +778,8 @@ class GPTForCausalLM(Layer):
             toks = jnp.concatenate(out, axis=1)
         rest = max_new_tokens - n_cached
         if rest > 0:
-            return self._generate_sliding(toks, rest, temperature, top_k)
+            return self._generate_sliding(toks, rest, temperature, top_k,
+                                          top_p)
         return Tensor(toks)
 
     def _decode_fns(self):
@@ -785,7 +805,8 @@ class GPTForCausalLM(Layer):
                 jnp.asarray, quantize_decode_params(self._params()))
         return self._int8_params
 
-    def _generate_sliding(self, toks, max_new_tokens, temperature, top_k):
+    def _generate_sliding(self, toks, max_new_tokens, temperature, top_k,
+                          top_p=None):
         """Full-context recompute with a sliding window — the continuation
         once generation outgrows the KV cache (= max_seq_len). Every window
         is full-width here, so the jitted forward compiles once."""
@@ -797,6 +818,7 @@ class GPTForCausalLM(Layer):
         fwd = self._sliding_fwd
         for _ in range(max_new_tokens):
             ctx = toks[:, -cfg.max_seq_len:]
-            nxt = _sample(fwd(self._decode_params(), ctx), temperature, top_k)
+            nxt = _sample(fwd(self._decode_params(), ctx), temperature,
+                          top_k, top_p)
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         return Tensor(toks)

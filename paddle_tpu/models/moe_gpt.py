@@ -266,7 +266,7 @@ def _decode_fns_for(config):
 
 
 def generate(params, config, prompt, max_new_tokens, temperature=0.0,
-             top_k=None, key=None):
+             top_k=None, key=None, *, top_p=None):
     """Functional greedy/sampled generation over the KV cache. ``prompt``:
     [B, T0] int32 with T0 < max_seq_len; generation is capped at the cache
     window (T0 + n <= max_seq_len + 1). ``key`` makes sampling
@@ -294,7 +294,7 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
         step_key = None
         if key is not None:
             key, step_key = jax.random.split(key)
-        nxt = _sample(logits, temperature, top_k, key=step_key)
+        nxt = _sample(logits, temperature, top_k, top_p, key=step_key)
         out.append(nxt[:, None])
         if i + 1 < n:
             logits, cache = step(params, nxt, jnp.int32(T0 + i), cache)

@@ -85,6 +85,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     def pure(v, w, b, rm, rv):
         axes = tuple(i for i in range(v.ndim) if i != (chan_axis % v.ndim))
+        # ONE channel-broadcast shape for both the variance and the
+        # normalize reshapes (review r4b: two hand-rolled copies diverge)
+        shape = [1] * v.ndim
+        shape[chan_axis % v.ndim] = v.shape[chan_axis % v.ndim]
         if use_batch_stats:
             mean = jnp.mean(v, axis=axes)
             # two-pass variance: the one-pass E[x^2]-mean^2 form goes
@@ -92,9 +96,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             # near-constant with a large mean (true var ~1e-6 computed as
             # -1.5e-5 < -eps) -> rsqrt(negative) NaN'd a real ResNet run
             # (journey r4b, deterministic replay in the regression test)
-            shape_m = [1] * v.ndim
-            shape_m[chan_axis % v.ndim] = v.shape[chan_axis % v.ndim]
-            var = jnp.mean(jnp.square(v - jnp.reshape(mean, shape_m)),
+            var = jnp.mean(jnp.square(v - jnp.reshape(mean, shape)),
                            axis=axes)
             if mesh_axis is not None:
                 try:
@@ -122,8 +124,6 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                     # reference SyncBatchNorm degrades to plain BatchNorm
         else:
             mean, var = rm, rv
-        shape = [1] * v.ndim
-        shape[chan_axis] = v.shape[chan_axis]
         out = (v - jnp.reshape(mean, shape)) * jax.lax.rsqrt(
             jnp.reshape(var, shape) + epsilon)
         if w is not None:

@@ -203,3 +203,54 @@ def test_gqa_moe_train_and_decode():
     l1 = logits1[:, 0] if logits1.ndim == 3 else logits1
     np.testing.assert_allclose(np.asarray(l1), np.asarray(full9[:, 8]),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_top_p_nucleus_sampling():
+    """top_p must restrict sampling to the smallest prefix of the sorted
+    distribution reaching p, always keep the argmax, and compose with
+    top_k."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models.gpt import _sample
+
+    # distribution: probs ~ [0.6, 0.3, 0.05, 0.03, 0.02]
+    probs = np.array([[0.6, 0.3, 0.05, 0.03, 0.02]], np.float32)
+    logits = jnp.asarray(np.log(probs))
+    keys = jax.random.split(jax.random.PRNGKey(0), 300)
+    draws = np.array([int(_sample(logits, 1.0, None, 0.8, key=k)[0])
+                      for k in keys[:150]])
+    assert set(draws) <= {0, 1}, set(draws)   # 0.6+0.3 >= 0.8 prefix
+    # a dominant token with prob > p must still be sampleable (exclusive
+    # cumsum keeps the first token)
+    draws2 = np.array([int(_sample(logits, 1.0, None, 0.1, key=k)[0])
+                       for k in keys[:20]])
+    assert set(draws2) == {0}
+    # composes with top_k=1 -> deterministic argmax
+    draws3 = np.array([int(_sample(logits, 1.0, 1, 0.99, key=k)[0])
+                       for k in keys[:10]])
+    assert set(draws3) == {0}
+    # generate() end-to-end with top_p
+    from paddle_tpu.models import gpt as G
+    cfg = G.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=2, max_seq_len=32, dtype='float32',
+                      use_flash=False, remat=False)
+    m = G.GPTForCausalLM(cfg)
+    out = m.generate(jnp.zeros((1, 4), jnp.int32), max_new_tokens=5,
+                     temperature=0.9, top_p=0.9)
+    assert out.shape[1] == 9
+
+
+def test_top_p_degenerate_values():
+    """top_p <= 0 degrades to greedy (argmax always kept), never to a
+    stream of token 0 (review r4b)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models.gpt import _sample
+    probs = np.array([[0.05, 0.6, 0.3, 0.03, 0.02]], np.float32)
+    logits = jnp.asarray(np.log(probs))
+    for p in (0.0, -1.0, 1e-9):
+        draws = {int(_sample(logits, 1.0, None, p, key=k)[0])
+                 for k in jax.random.split(jax.random.PRNGKey(1), 10)}
+        assert draws == {1}, (p, draws)   # argmax is index 1, NOT 0

@@ -777,6 +777,28 @@ def test_interpolate_mode_parity():
                    align_corners=True).sum().backward()
     assert np.isfinite(np.asarray(xp.grad)).all()
 
+    # align_mode=1 (src = i*in/out — the PaddleDetection convention), up
+    # and down, vs a hand reference
+    def ref_mode1_1d(v, n_out):
+        n_in = len(v)
+        out = np.zeros(n_out)
+        for i in range(n_out):
+            s = i * n_in / n_out
+            s0 = min(int(np.floor(s)), n_in - 1)
+            s1 = min(s0 + 1, n_in - 1)
+            f = s - s0
+            out[i] = v[s0] * (1 - f) + v[s1] * f
+        return out
+
+    v = np.random.RandomState(3).rand(7).astype('float32')
+    x1 = paddle.to_tensor(v.reshape(1, 1, 7))
+    for n_out in (12, 4):
+        o = np.asarray(F2.interpolate(x1, size=[n_out], mode='linear',
+                                      align_mode=1,
+                                      data_format='NCW')._value).ravel()
+        np.testing.assert_allclose(o, ref_mode1_1d(v, n_out), atol=1e-6,
+                                   err_msg=f'align_mode=1 size {n_out}')
+
 
 def test_batchnorm_near_constant_channel_no_nan():
     """Journey r4b (deterministic replay of a real ResNet-18 NaN): a
