@@ -62,6 +62,40 @@ class StaticFunction:
         self._layer = getattr(function, '__self__', None)
         self._cache = {}       # cache_key -> (jitted_pure, holder)
 
+    def __get__(self, obj, objtype=None):
+        # descriptor protocol: `@to_static` in a CLASS BODY (the reference
+        # idiom) must bind `self` like a method — Layer.__call__ then
+        # reaches __call__ with the instance first, and _bound_layer
+        # routes through the layer path (r4b). A plain closure (not
+        # functools.partial) so jit.save can still read the decoration
+        # metadata off layer.forward.
+        if obj is None:
+            return self
+        sf = self
+
+        def bound(*args, **kwargs):
+            return sf(obj, *args, **kwargs)
+        bound.__self__ = obj
+        bound._input_spec = self._input_spec
+        bound._static_function = sf
+        return bound
+
+    def _cache_for(self, layer):
+        # one class-level StaticFunction serves EVERY instance under
+        # class-body decoration, and _build bakes the instance into the
+        # compiled closure — so compiled entries must be per-instance
+        # (review r4b: instance B silently ran A's trace). WeakKey: a
+        # dropped instance must not pin its compiled programs.
+        if layer is None:
+            return self._cache
+        import weakref
+        if not hasattr(self, '_inst_caches'):
+            self._inst_caches = weakref.WeakKeyDictionary()
+        cache = self._inst_caches.get(layer)
+        if cache is None:
+            cache = self._inst_caches[layer] = {}
+        return cache
+
     def _bound_layer(self, args):
         if self._layer is not None:
             return self._layer, args
@@ -153,12 +187,13 @@ class StaticFunction:
 
         cache_key = (training, tensor_like, len(arg_arrays),
                      _hashable(static_args), _hashable(kwargs), tuple(pnames))
-        entry = self._cache.get(cache_key)
+        cache = self._cache_for(layer)
+        entry = cache.get(cache_key)
         if entry is None:
             static_ctx = {'pnames': pnames, 'bnames': bnames,
                           'static_args': static_args, 'nargs': len(arg_arrays)}
             entry = self._build(layer, training, tensor_like, static_ctx, kwargs)
-            self._cache[cache_key] = entry
+            cache[cache_key] = entry
         jitted, holder = entry
 
         dyn_tensors = [call_args[i] if isinstance(call_args[i], Tensor)

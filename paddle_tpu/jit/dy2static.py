@@ -20,9 +20,12 @@ containing break lowers to its while-form first; break/continue inside
 the if become the else-continuation — _ReturnLowering, the reference's
 return_transformer.py), and attribute/subscript stores via slot
 localization (``self.n = ...`` in a tensor branch/loop round-trips as a
-loop carrier). Still-unsupported constructs (``return`` inside a LOOP
-body, a var bound in only one branch) raise Dy2StaticError with an
-actionable message instead of jax's TracerBoolConversionError.
+loop carrier), and ``return`` inside a LOOP body — lowered to a flag +
+break + post-loop re-emission of the return expression
+(_LoopReturnLowering), so tensor-conditioned loop returns become lax
+state with no value carrier to synthesize. Still-unsupported constructs
+(a var bound in only one branch) raise Dy2StaticError with an actionable
+message instead of jax's TracerBoolConversionError.
 """
 import ast
 import functools
@@ -140,11 +143,20 @@ def convert_while(cond_fn, body_fn, names, init_vals):
         # reuse `first` for the first test: re-evaluating would double any
         # side effects in the condition expression
         vals = tuple(init_vals)
-        cont = _to_py_bool(first)
-        while cont:
+        cont = first
+        while True:
+            if _is_traced(cont):
+                # tensor-ness entered THROUGH the body (e.g. a traced
+                # break/loop-return flag in an otherwise-python loop, with
+                # no traced carrier at entry): continue as a lax loop from
+                # the current state. The condition is re-evaluated once on
+                # re-entry (condition side effects would double — same
+                # caveat as the first-test reuse above).
+                return convert_while(cond_fn, body_fn, names, vals)
+            if not _to_py_bool(cont):
+                return vals
             vals = tuple(body_fn(*vals))
-            cont = _to_py_bool(cond_fn(*vals))
-        return vals
+            cont = cond_fn(*vals)
 
     _check_bound(names, init_vals, 'while')
     u_init = tuple(_unwrap(v) for v in init_vals)
@@ -374,10 +386,10 @@ def _mods_of(*stmt_lists):
             return None
         names |= info.assigned
     # generated names are internal EXCEPT the break/continue flags, the
-    # while-form loop index, and the return-lowering result carrier —
-    # those are genuine branch/loop-carried state
+    # while-form loop index, the return-lowering result carrier, and the
+    # loop-return flags — those are genuine branch/loop-carried state
     keep = (f'{_GEN_PREFIX}brk', f'{_GEN_PREFIX}cont', f'{_GEN_PREFIX}idx',
-            f'{_GEN_PREFIX}rv', _ATTR_PREFIX)
+            f'{_GEN_PREFIX}rv', f'{_GEN_PREFIX}lr', _ATTR_PREFIX)
     return sorted(n for n in names
                   if not n.startswith(_GEN_PREFIX) or n.startswith(keep))
 
@@ -490,6 +502,76 @@ def _const(v):
     return ast.Constant(value=v)
 
 
+class _LoopReturnLowering(ast.NodeTransformer):
+    """``return`` inside a LOOP body (reference return_transformer.py's
+    loop case). Lowered to flag + break + a post-loop re-emission:
+
+        while c:                    _pt_lr1 = False
+            if t: return x          while c:
+        rest                 =>         if t: _pt_lr1 = True; break
+                                    if _pt_lr1: return x
+                                    rest
+
+    Only the plain-bool FLAG is loop-carried — never the value — so no
+    carrier of unknown shape/dtype needs synthesizing: the loop state at
+    the break is exactly the state after the loop (the break/continue
+    guards freeze the rest of the body), so re-evaluating the return
+    expression post-loop yields the same value. Requirements that follow:
+    the return expression must be pure (it is evaluated once, after the
+    loop — tensor expressions are; a side-effecting call would run at
+    post-loop time), and its free variables must be bound on every path
+    (the existing one-branch-binding rule). The emitted break/post-if then
+    ride the existing break-flag and early-return lowerings, so a
+    tensor-conditioned loop return becomes lax state with no new
+    machinery. Runs INNERMOST-first: a return in a nested loop becomes
+    flag+break there, and its post-loop ``if flag: return expr`` is
+    rewritten again by the enclosing loop's pass."""
+
+    def __init__(self):
+        self._uid = 0
+        self.applied = False
+
+    _INNER_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+    def _rewrite_returns(self, stmts, flags):
+        """Replace direct returns (not inside nested loops or nested
+        function/class scopes, which own their returns) with flag-set +
+        break; record (flag, value_expr) into ``flags``."""
+        out = []
+        for st in stmts:
+            if isinstance(st, ast.Return):
+                self.applied = True
+                self._uid += 1
+                name = f'{_GEN_PREFIX}lr{self._uid}'
+                flags.append((name, st.value or _const(None)))
+                out.append(_assign(name, _const(True)))
+                out.append(ast.Break())
+                continue
+            if not isinstance(st, (ast.For, ast.While) + self._INNER_SCOPES):
+                for attr in ('body', 'orelse', 'finalbody'):
+                    blk = getattr(st, attr, None)
+                    if blk:
+                        setattr(st, attr, self._rewrite_returns(blk, flags))
+                for h in getattr(st, 'handlers', []) or []:
+                    h.body = self._rewrite_returns(h.body, flags)
+            out.append(st)
+        return out
+
+    def _lower_loop(self, node):
+        self.generic_visit(node)           # innermost loops first
+        flags = []
+        node.body = self._rewrite_returns(node.body, flags)
+        if not flags:
+            return node
+        pre = [_assign(n, _const(False)) for n, _ in flags]
+        post = [ast.If(test=_load(n), body=[ast.Return(value=v)], orelse=[])
+                for n, v in flags]
+        return pre + [node] + post
+
+    visit_For = _lower_loop
+    visit_While = _lower_loop
+
+
 class _ReturnLowering:
     """Early-``return`` support (reference: dygraph_to_static/
     return_transformer.py:1). A ``return`` inside an if-structure is lowered
@@ -506,8 +588,9 @@ class _ReturnLowering:
     tensor-conditioned early returns convertible to lax.cond. Continuations
     are deep-copied into each arm, so k sequential return-ifs cost O(2^k)
     code size — fine for the 1-3 early returns real code has. ``return``
-    inside a LOOP body still raises the documented Dy2StaticError (a loop
-    carrier of unknown shape cannot be synthesized)."""
+    inside a LOOP body is handled by _LoopReturnLowering BEFORE this pass
+    (flag + break + post-loop re-emission), so by the time this runs every
+    return sits in straight-line/if code."""
 
     RV = f'{_GEN_PREFIX}rv'
 
@@ -1097,7 +1180,9 @@ def convert_control_flow(fn):
     jax.jit tracing then applies, exactly as before.
     """
     bound_self = getattr(fn, '__self__', None)
-    raw = fn.__func__ if bound_self is not None else fn
+    # method-like objects without __func__ (e.g. the StaticFunction bound
+    # accessor) convert as themselves
+    raw = getattr(fn, '__func__', fn) if bound_self is not None else fn
     try:
         src = textwrap.dedent(inspect.getsource(raw))
         tree = ast.parse(src)
@@ -1110,6 +1195,10 @@ def convert_control_flow(fn):
         return fn
     fdef.decorator_list = []           # avoid re-entering to_static on exec
     try:
+        # loop-returns become flag+break+post-loop-return FIRST, so the
+        # emitted pieces ride the break and early-return lowerings below
+        _LoopReturnLowering().visit(fdef)
+        ast.fix_missing_locations(tree)
         _ReturnLowering().run(fdef)
         bc = _BreakContinueTransformer()
         bc.visit(fdef)
