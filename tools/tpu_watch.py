@@ -118,6 +118,38 @@ def main():
             subprocess.run(['git', 'commit', '-m',
                             'watcher: re-banked live TPU bench after tunnel '
                             'recovery'], cwd=REPO)
+            # post-bank diagnostics (logged, committed; failures tolerated):
+            # segment-level step-time breakdown + the scan-unroll tune rung
+            for argv, out, bound in (
+                    (['tools/tpu_breakdown.py'], 'TPU_BREAKDOWN.json', 2400),
+                    (['tools/tpu_tune.py', '--round3'], 'TPU_TUNE_R3.txt',
+                     3600)):
+                text, note = None, ''
+                try:
+                    p = subprocess.run([sys.executable] + argv,
+                                       capture_output=True, text=True,
+                                       timeout=bound, cwd=REPO)
+                    text, note = p.stdout, f'rc={p.returncode}'
+                    if p.returncode != 0 and not (text or '').strip():
+                        text = None    # keep any previously banked artifact
+                except subprocess.TimeoutExpired as e:
+                    # breakdown prints per-segment JSON lines exactly so a
+                    # timeout still yields partial data
+                    text = e.stdout
+                    if isinstance(text, bytes):
+                        text = text.decode('utf-8', 'replace')
+                    note = f'timeout>{bound}s (partial output kept)'
+                path = os.path.join(REPO, out)
+                if text and text.strip():
+                    tmp = path + '.tmp'
+                    with open(tmp, 'w') as f:
+                        f.write(text)
+                    os.replace(tmp, path)
+                    subprocess.run(['git', 'add', out], cwd=REPO)
+                log(f'{argv[0]}: {note}')
+            subprocess.run(['git', 'commit', '-m',
+                            'watcher: post-bank breakdown + unroll tune'],
+                           cwd=REPO)
             return 0
         time.sleep(110)
     log('watcher expired')
