@@ -99,14 +99,44 @@ class Categorical(Distribution):
     def __init__(self, logits, name=None):
         self.logits = _v(logits)
 
+    def _validate_weights(self, what):
+        """sample/probs/log_prob treat `logits` as probability WEIGHTS —
+        negative or all-zero rows would silently produce constant samples,
+        negative 'probabilities', or NaN (review r4b). The reference's
+        multinomial raises on invalid weights; match it (eager only — a
+        traced value cannot be checked data-dependently)."""
+        w = self.logits
+        if isinstance(w, jax.core.Tracer):
+            return
+        import numpy as _np
+        wn = _np.asarray(w)
+        if (wn < 0).any() or not (wn.sum(axis=-1) > 0).all():
+            raise ValueError(
+                f'Categorical.{what} treats the input as unnormalized '
+                'probability weights (reference multinomial semantics): '
+                'every weight must be >= 0 with a positive row sum. For '
+                'log-space inputs exponentiate first (entropy/kl use '
+                'softmax and accept raw logits).')
+
     def sample(self, shape=(), seed=0):
+        # reference semantics (distribution.py:771): sample routes through
+        # paddle.multinomial, which treats `logits` as UNNORMALIZED
+        # PROBABILITY WEIGHTS (normalized by their sum) — NOT softmax.
+        # entropy/kl_divergence below use softmax, matching the reference's
+        # own (documented-by-implementation) asymmetry.
+        self._validate_weights('sample')
         shape = tuple(shape)
-        out = jax.random.categorical(next_key(), self.logits, axis=-1,
+        w = jnp.log(jnp.maximum(self.logits, 0.0))   # -inf for weight 0
+        out = jax.random.categorical(next_key(), w, axis=-1,
                                      shape=shape + self.logits.shape[:-1])
         return Tensor(out.astype(jnp.int32))
 
     def _probs(self):
         return jax.nn.softmax(self.logits, axis=-1)
+
+    def _weight_probs(self):
+        # reference probs(): logits / logits.sum(-1)
+        return self.logits / jnp.sum(self.logits, axis=-1, keepdims=True)
 
     def entropy(self):
         p = self._probs()
@@ -114,16 +144,24 @@ class Categorical(Distribution):
         return Tensor(-jnp.sum(p * logp, axis=-1))
 
     def log_prob(self, value):
+        self._validate_weights('log_prob')
+
         def pure(v):
-            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            logp = jnp.log(self._weight_probs())
             idx = jnp.asarray(v).astype(jnp.int32)
+            if logp.ndim == 1:     # 1-D dist, any number of query values
+                return logp[idx]
             return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
         return apply_op(pure, value)
 
     def probs(self, value):
+        self._validate_weights('probs')
+
         def pure(v):
-            p = self._probs()
+            p = self._weight_probs()
             idx = jnp.asarray(v).astype(jnp.int32)
+            if p.ndim == 1:
+                return p[idx]
             return jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0]
         return apply_op(pure, value)
 

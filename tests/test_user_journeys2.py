@@ -821,3 +821,28 @@ def test_batchnorm_near_constant_channel_no_nan():
     # and the running stats stayed finite/sane
     assert np.isfinite(np.asarray(bn._variance._value)).all()
     assert (np.asarray(bn._variance._value) >= 0).all()
+
+
+def test_categorical_reference_semantics():
+    """Reference distribution.py quirk, matched exactly: sample() and
+    probs()/log_prob() treat `logits` as unnormalized probability WEIGHTS
+    (multinomial semantics, normalized by sum), while entropy()/
+    kl_divergence() use softmax."""
+    from paddle_tpu.distribution import Categorical
+    paddle.seed(0)
+    w = np.array([0.1, 0.2, 0.7], np.float32)
+    c = Categorical(paddle.to_tensor(w))
+    s = np.asarray(c.sample([30000])._value)
+    freq = np.bincount(s.astype(int), minlength=3) / 30000
+    np.testing.assert_allclose(freq, w, atol=0.02)
+    np.testing.assert_allclose(
+        np.asarray(c.probs(paddle.to_tensor(np.array([0, 1, 2])))._value),
+        w, atol=1e-6)
+    np.testing.assert_allclose(
+        float(np.asarray(c.log_prob(
+            paddle.to_tensor(np.array([2])))._value)[0]),
+        np.log(0.7), atol=1e-6)
+    # entropy/kl stay softmax-based (the reference's own asymmetry)
+    p_sm = np.exp(w) / np.exp(w).sum()
+    np.testing.assert_allclose(float(c.entropy()),
+                               -(p_sm * np.log(p_sm)).sum(), atol=1e-6)

@@ -60,10 +60,10 @@ def probe_alive():
     return False
 
 
-def write_atomic(path, obj):
+def write_atomic(path, obj, text=False):
     tmp = path + '.tmp'
     with open(tmp, 'w') as f:
-        json.dump(obj, f)
+        f.write(obj) if text else json.dump(obj, f)
     os.replace(tmp, path)   # bench.py's fallback may read LIVE concurrently
 
 
@@ -124,27 +124,26 @@ def main():
                     (['tools/tpu_breakdown.py'], 'TPU_BREAKDOWN.json', 2400),
                     (['tools/tpu_tune.py', '--round3'], 'TPU_TUNE_R3.txt',
                      3600)):
-                text, note = None, ''
+                text, note, complete = None, '', False
                 try:
                     p = subprocess.run([sys.executable] + argv,
                                        capture_output=True, text=True,
                                        timeout=bound, cwd=REPO)
                     text, note = p.stdout, f'rc={p.returncode}'
-                    if p.returncode != 0 and not (text or '').strip():
-                        text = None    # keep any previously banked artifact
+                    complete = p.returncode == 0
                 except subprocess.TimeoutExpired as e:
                     # breakdown prints per-segment JSON lines exactly so a
                     # timeout still yields partial data
                     text = e.stdout
                     if isinstance(text, bytes):
                         text = text.decode('utf-8', 'replace')
-                    note = f'timeout>{bound}s (partial output kept)'
+                    note = f'timeout>{bound}s (partial output)'
                 path = os.path.join(REPO, out)
-                if text and text.strip():
-                    tmp = path + '.tmp'
-                    with open(tmp, 'w') as f:
-                        f.write(text)
-                    os.replace(tmp, path)
+                # a failed/partial run must never clobber a COMPLETE banked
+                # artifact — write only on success or when nothing is banked
+                if text and text.strip() and (complete
+                                              or not os.path.exists(path)):
+                    write_atomic(path, text, text=True)
                     subprocess.run(['git', 'add', out], cwd=REPO)
                 log(f'{argv[0]}: {note}')
             subprocess.run(['git', 'commit', '-m',
