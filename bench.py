@@ -55,6 +55,20 @@ def _peak_flops(platform):
     return PEAK_FLOPS['v5e'], False
 
 
+def _mfu_pair(tps, n_params, cfg, peak):
+    """-> (mfu, mfu_attn_incl). The first is the cross-round-comparable
+    6*N*tps formula; the second adds causal attention FLOPs
+    (fwd QK^T+AV = 2*S*h per layer per token causal-averaged, x3 for
+    fwd+bwd => 6*L*S*h per token), which the 6N formula ignores — at seq
+    4096 attention is a large share of the real work (VERDICT r4 weak #6).
+    Remat recompute is deliberately NOT counted (model FLOPs, not hardware
+    FLOPs)."""
+    mfu = 6.0 * n_params * tps / peak
+    attn_per_tok = 6.0 * cfg['layers'] * cfg['seq'] * cfg['hidden']
+    return round(mfu, 4), round(
+        (6.0 * n_params + attn_per_tok) * tps / peak, 4)
+
+
 # --------------------------------------------------------------------------
 # child-process entry points
 # --------------------------------------------------------------------------
@@ -123,6 +137,9 @@ def _child_train(cfg):
     gcfg = gpt.GPTConfig(vocab_size=cfg['vocab'], hidden_size=cfg['hidden'],
                          num_layers=cfg['layers'], num_heads=cfg['heads'],
                          max_seq_len=seq, dtype='bfloat16',
+                         # the >=1B rung stores params AND Adam moments in
+                         # bf16 (plus 'full' remat) so 1.3B fits v5e HBM
+                         param_dtype=cfg.get('param_dtype', 'float32'),
                          remat=cfg.get('remat', True),
                          remat_policy=cfg.get('remat_policy', 'dots'),
                          use_flash=cfg.get('use_flash', True),
@@ -199,11 +216,18 @@ def _child_eager():
 
 
 def _child_decode():
-    """Autoregressive serving throughput: KV-cache decode steps/sec on the
-    bench GPT config (batch 8). Fenced by per-chunk host reads."""
+    """Autoregressive serving throughput: KV-cache decode on the bench GPT
+    config (batch 8). The timed region is the ON-DEVICE generation loop
+    (gpt.make_generate_loop — N steps per dispatch): round-4 measured the
+    per-token python loop at ~71 steps/s, which is tunnel-dispatch-bound,
+    not HBM-bound (VERDICT r5 item 2). A short per-step python loop is kept
+    as `decode_dispatch_tokens_per_sec` to quantify the dispatch tax, and
+    the output carries a bytes-per-step accounting so the headline can be
+    read against the HBM roofline."""
     _arm_watchdog(CONFIG_TIMEOUT_S)
     import jax
     _force_cpu_if_requested()
+    import numpy as np
     import jax.numpy as jnp
     from paddle_tpu.models import gpt
 
@@ -217,38 +241,81 @@ def _child_decode():
         cfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024,
                             num_layers=24, num_heads=16, max_seq_len=1024,
                             dtype='bfloat16', remat=False, use_flash=False)
-        B, T0, N = 8, 128, 64
-    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
-    prefill, step = gpt.make_decode_fns(cfg)
+        B, T0, N = 8, 128, 128
     prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
                                 cfg.vocab_size)
-    def run(p):
-        cache = gpt.init_kv_cache(cfg, B)
-        logits, cache = prefill(p, prompt, cache)
-        tok = jnp.argmax(logits, -1).astype(jnp.int32)
-        # warm the step compile, then fence
-        logits, cache = step(p, tok, jnp.int32(T0), cache)
-        float(logits[0, 0])
-        t0 = time.perf_counter()
-        for i in range(1, N):
-            logits, cache = step(p,
-                                 jnp.argmax(logits, -1).astype(jnp.int32),
-                                 jnp.int32(T0 + i), cache)
-        float(logits[0, 0])             # host read fences the chain
-        return B * (N - 1) / (time.perf_counter() - t0)
 
-    out = {'decode_tokens_per_sec': run(params)}
+    def bytes_accounting(p, c):
+        leaves = jax.tree_util.tree_leaves(p)
+        w_mb = sum(x.size * x.dtype.itemsize for x in leaves) / 1e6
+        # per decode step the kernel streams cache rows [0, pos): average
+        # over the timed steps
+        kv_leaves = jax.tree_util.tree_leaves(gpt.init_kv_cache(c, B))
+        kv_full_mb = sum(x.size * x.dtype.itemsize for x in kv_leaves) / 1e6
+        kv_mb = kv_full_mb * (T0 + N / 2) / c.max_seq_len
+        return w_mb, kv_mb
+
+    def run(c, p, key):
+        prefill, _step = gpt.make_decode_fns(c)
+        loop = gpt.make_generate_loop(c)   # greedy
+
+        def one_pass():
+            cache = gpt.init_kv_cache(c, B)
+            logits, cache = prefill(p, prompt, cache)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            toks, _ = loop(p, tok, jnp.int32(T0), cache,
+                           jax.random.PRNGKey(7), N - 1)
+            return toks
+
+        _ = np.asarray(one_pass())          # warm both compiles + fence
+        t0 = time.perf_counter()
+        toks = one_pass()
+        last = np.asarray(toks)             # host read fences the loop
+        dt = time.perf_counter() - t0
+        w_mb, kv_mb = bytes_accounting(p, c)
+        steps_per_sec = (N - 1) / dt
+        out[key] = B * (N - 1) / dt
+        out[key.replace('_tokens_per_sec', '_hbm_gbps_est')] = round(
+            (w_mb + kv_mb) / 1e3 * steps_per_sec, 1)
+        out[key.replace('_tokens_per_sec', '_weight_mb')] = round(w_mb, 1)
+        out[key.replace('_tokens_per_sec', '_kv_read_mb_avg')] = round(
+            kv_mb, 1)
+        # a token-range failure flags THIS variant without discarding the
+        # other variants' already-measured numbers
+        if not ((last >= 0).all() and (last < c.vocab_size).all()):
+            out[key.replace('_tokens_per_sec', '_token_range_ok')] = False
+
+    out = {}
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    run(cfg, params, 'decode_tokens_per_sec')
+
+    # dispatch-tax reference: the old per-step python loop, few steps only
+    prefill, step = gpt.make_decode_fns(cfg)
+    cache = gpt.init_kv_cache(cfg, B)
+    logits, cache = prefill(params, prompt, cache)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    logits, cache = step(params, tok, jnp.int32(T0), cache)
+    float(logits[0, 0])
+    nd = min(N, 16)
+    t0 = time.perf_counter()
+    for i in range(1, nd):
+        logits, cache = step(params,
+                             jnp.argmax(logits, -1).astype(jnp.int32),
+                             jnp.int32(T0 + i), cache)
+    float(logits[0, 0])
+    out['decode_dispatch_tokens_per_sec'] = B * (nd - 1) / (
+        time.perf_counter() - t0)
+
     # weight-only int8 A/B: halved weight bytes on the HBM-bound step
-    # (ops/weight_only.py); same jitted fns — the pytree shape retraces
+    # (ops/weight_only.py); same functional body — the pytree shape retraces
     qparams = jax.tree_util.tree_map(jnp.asarray,
                                      gpt.quantize_decode_params(params))
-    out['decode_int8_tokens_per_sec'] = run(qparams)
+    run(cfg, qparams, 'decode_int8_tokens_per_sec')
     # + int8 KV cache (per-row scales; int8 flash decode kernel on TPU):
     # at this config the cache is the bigger HBM stream than the weights
     import dataclasses
     cfg = dataclasses.replace(cfg, kv_cache_int8=True)
-    prefill, step = gpt.make_decode_fns(cfg)
-    out['decode_int8kv_tokens_per_sec'] = run(qparams)
+    run(cfg, qparams, 'decode_int8kv_tokens_per_sec')
     print(json.dumps(out))
 
 
@@ -282,7 +349,39 @@ def _child_predictor():
         _ = np.asarray(out[0])
         lat.append(time.perf_counter() - t0)
     lat.sort()
-    print(json.dumps({'p50_ms': lat[len(lat) // 2] * 1e3}))
+    res = {'p50_ms': lat[len(lat) // 2] * 1e3}
+
+    # --- device-side numbers (VERDICT r4 weak #3: the e2e p50 above is
+    # dominated by the 30-70 ms tunnel RTT; compute is ~1 ms). A chain of K
+    # dependent jitted calls is dispatched asynchronously and fenced ONCE,
+    # so dt/K amortizes the RTT away and approaches on-device latency.
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.layer_base import (buffer_arrays, functional_call,
+                                          param_arrays)
+    params, bufs = param_arrays(net), buffer_arrays(net)
+
+    @jax.jit
+    def fwd(p, b, xx):
+        return functional_call(net, p, b, xx)[0]
+
+    def chain_ms(batch, k=40):
+        xx = jnp.asarray(np.random.rand(batch, 3, 224, 224).astype('f4'))
+        y = fwd(params, bufs, xx)
+        _ = np.asarray(y)                      # compile + fence
+        t0 = time.perf_counter()
+        for _ in range(k):
+            # output->input dependency serializes the chain on device
+            y = fwd(params, bufs, xx + y.sum() * 0)
+        _ = np.asarray(y)
+        return (time.perf_counter() - t0) / k * 1e3
+
+    res['device_ms_b1'] = chain_ms(1)
+    for b in (8, 32):
+        ms = chain_ms(b)
+        res[f'device_ms_b{b}'] = ms
+        res[f'qps_b{b}'] = b / ms * 1e3
+    print(json.dumps(res))
 
 
 def _child_smoke():
@@ -540,7 +639,8 @@ def main(fast=False):
     out['loss'] = round(result['loss'], 4)
     out['n_params'] = result['n_params']
     peak, gen_known = _peak_flops(platform)
-    out['mfu'] = round(6.0 * result['n_params'] * tps / peak, 4)
+    out['mfu'], out['mfu_attn_incl'] = _mfu_pair(
+        tps, result['n_params'], out['config'], peak)
     # Sanity fence: mfu > 1 is physically impossible. When the TPU generation
     # is unknown, judge against the fastest known chip so a v5e default never
     # falsely condemns a legitimate number measured on newer hardware.
@@ -556,14 +656,55 @@ def main(fast=False):
         out['metric'] = 'gpt350m_INVALID_dispatch_only_tokens_per_sec'
         out['raw_tokens_per_sec'] = out['value']
         out['raw_mfu'] = out['mfu']
+        out['raw_mfu_attn_incl'] = out['mfu_attn_incl']
         out['value'] = 0.0
         out['vs_baseline'] = 0.0
         out['mfu'] = 0.0
+        out['mfu_attn_incl'] = 0.0
+
+    if platform != 'cpu' and 'INVALID' not in out['metric'] and not fast:
+        # ---- >=1B rung (VERDICT r5 item 1): GPT-3-1.3B-class config.
+        # hidden 2048 doubles the GEMM edge vs the 337M config — the
+        # cheapest MFU lever — and is the north-star model class. bf16
+        # params + bf16 Adam moments + full remat fit v5e's 16 GB:
+        # 2.56 (params) + 2.56 (grads) + 5.1 (moments) + ~0.8 GB acts.
+        big_cfgs = [
+            dict(batch=8, seq=1024, hidden=2048, layers=24, heads=16,
+                 vocab=32768, iters=10, remat_policy='full',
+                 param_dtype='bfloat16'),
+            dict(batch=4, seq=1024, hidden=2048, layers=24, heads=16,
+                 vocab=32768, iters=10, remat_policy='dots',
+                 param_dtype='bfloat16'),
+            dict(batch=4, seq=1024, hidden=2048, layers=24, heads=16,
+                 vocab=32768, iters=10, remat_policy='full',
+                 param_dtype='bfloat16'),
+        ]
+        for bcfg in big_cfgs:
+            bres, bnote = _run_child(['--child-train', json.dumps(bcfg)],
+                                     CONFIG_TIMEOUT_S)
+            if bres is not None:
+                btps = bres['tokens_per_sec']
+                m, ma = _mfu_pair(btps, bres['n_params'], bcfg, peak)
+                mg, _ = _mfu_pair(btps, bres['n_params'], bcfg, guard_peak)
+                key = ('gpt1p3b_tokens_per_sec' if mg <= 1.0
+                       else 'gpt1p3b_INVALID_dispatch_only_tokens_per_sec')
+                out[key] = round(btps, 1)
+                out['gpt1p3b_n_params'] = bres['n_params']
+                out['gpt1p3b_loss'] = round(bres['loss'], 4)
+                out['gpt1p3b_config'] = bcfg
+                if mg <= 1.0:
+                    out['gpt1p3b_mfu'], out['gpt1p3b_mfu_attn_incl'] = m, ma
+                break
+            print(f'1.3B rung {bcfg} failed: {bnote}', file=sys.stderr)
 
     if not fast:
         pred, pnote = _run_child(['--child-predictor'], PREDICTOR_TIMEOUT_S)
         if pred is not None:
             out['predictor_p50_ms'] = round(pred['p50_ms'], 3)
+            for k in ('device_ms_b1', 'device_ms_b8', 'qps_b8',
+                      'device_ms_b32', 'qps_b32'):
+                if k in pred:
+                    out[f'predictor_{k}'] = round(pred[k], 3)
         else:
             print(f'predictor bench failed: {pnote}', file=sys.stderr)
 
@@ -576,12 +717,9 @@ def main(fast=False):
     if platform != 'cpu':
         dec, dnote = _run_child(['--child-decode'], CONFIG_TIMEOUT_S)
         if dec is not None:
-            out['decode_tokens_per_sec'] = round(
-                dec['decode_tokens_per_sec'], 1)
-            for k in ('decode_int8_tokens_per_sec',
-                      'decode_int8kv_tokens_per_sec'):
-                if k in dec:
-                    out[k] = round(dec[k], 1)
+            for k, v in dec.items():
+                if k.startswith('decode_'):
+                    out[k] = round(v, 1)
         else:
             print(f'decode bench failed: {dnote}', file=sys.stderr)
 
@@ -598,8 +736,35 @@ def main(fast=False):
             if lres is not None:
                 out['tokens_per_sec_seq4096'] = round(
                     lres['tokens_per_sec'], 1)
+                _, lma = _mfu_pair(lres['tokens_per_sec'],
+                                   lres['n_params'], lc, peak)
+                out['mfu_attn_incl_seq4096'] = lma
             else:
                 print(f'long-context rung failed: {lnote}', file=sys.stderr)
+
+            # blockwise-xent value proof (VERDICT r5 item 8): at vocab 128k
+            # the naive loss materializes [8,1024,131072] f32 logits (4.3 GB
+            # live through the backward) — expected to OOM or regress on
+            # v5e; the blockwise path streams vocab chunks and holds.
+            vk = dict(batch=8, seq=1024, hidden=1024, layers=24, heads=16,
+                      vocab=131072, iters=8, xent_chunk=8192)
+            vres, vnote = _run_child(['--child-train', json.dumps(vk)],
+                                     CONFIG_TIMEOUT_S)
+            if vres is not None:
+                out['vocab128k_blockwise_tokens_per_sec'] = round(
+                    vres['tokens_per_sec'], 1)
+            else:
+                print(f'vocab128k blockwise failed: {vnote}',
+                      file=sys.stderr)
+            vn = dict(vk, xent_chunk=0)
+            vres2, vnote2 = _run_child(['--child-train', json.dumps(vn)],
+                                       CONFIG_TIMEOUT_S)
+            if vres2 is not None:
+                out['vocab128k_naive_tokens_per_sec'] = round(
+                    vres2['tokens_per_sec'], 1)
+            else:
+                # an OOM here IS the expected proof — record it honestly
+                out['vocab128k_naive_failed'] = vnote2[:300]
 
     print(json.dumps(out))
     return 0

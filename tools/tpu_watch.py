@@ -16,7 +16,12 @@ On a live probe, in order:
 No chip-holding process is ever SIGTERMed from a shell `timeout` — every
 bound is subprocess.run(timeout=...) from this parent (SIGKILL on expiry,
 applied only to the probe/bench CHILD, which bench.py already bounds
-internally). Run:  python tools/tpu_watch.py >> .tpu_watch_r4.log 2>&1 &
+internally). Run:  python tools/tpu_watch.py >> .tpu_watch_r5.log 2>&1 &
+
+Round-5 changes: the full profile now carries the >=1B rung + decode
+roofline numbers (the round's deliverables), so promotion also happens
+when the fresh run adds the gpt1p3b rung the bank lacks (at non-regressed
+headline), and the full-bench bound is raised for the extra rungs.
 """
 import json
 import os
@@ -87,7 +92,7 @@ def main():
                 # committed in the first minutes of tunnel life — the full
                 # bench can lose the tunnel 10 minutes in
                 log('no valid bank — running --fast first')
-                fast, fnote = run(['bench.py', '--fast'], 1500)
+                fast, fnote = run(['bench.py', '--fast'], 3000)
                 log(f'fast {fnote}: {fast}')
                 if (fast is not None and fast.get('metric') == HEADLINE
                         and fast.get('value') and not fast.get('banked')):
@@ -96,8 +101,8 @@ def main():
                     subprocess.run(['git', 'commit', '-m',
                                     'bank live TPU fast-bench (watcher)'],
                                    cwd=REPO)
-            log('full bench (this can take ~30 min)')
-            full, fnote = run(['bench.py'], 5400)
+            log('full bench (this can take ~45 min)')
+            full, fnote = run(['bench.py'], 7200)
             log(f'full {fnote}: {full}')
             if full is None or full.get('metric') != HEADLINE \
                     or not full.get('value') or full.get('banked'):
@@ -109,9 +114,13 @@ def main():
                 continue
             write_atomic(FULL, full)
             old = read_bank()
-            if full['value'] > old.get('value', 0):
+            adds_1p3b = ('gpt1p3b_tokens_per_sec' in full
+                         and 'gpt1p3b_tokens_per_sec' not in old
+                         and full['value'] >= 0.97 * old.get('value', 0))
+            if full['value'] > old.get('value', 0) or adds_1p3b:
                 write_atomic(LIVE, full)
-                log(f'PROMOTED: {full["value"]} > {old.get("value")}')
+                log(f'PROMOTED: {full["value"]} (old {old.get("value")}, '
+                    f'adds_1p3b={adds_1p3b})')
             else:
                 log(f'kept bank: {old.get("value")} >= {full["value"]}')
             subprocess.run(['git', 'add', LIVE, FULL], cwd=REPO)
