@@ -28,17 +28,21 @@ def _axis_deg(mesh, axes):
     return d
 
 
-def shard_spec(x, deg, axes):
-    """PartitionSpec sharding ``x``'s largest divisible dim over ``axes``."""
+def shard_spec(x, deg, axes, base=None):
+    """PartitionSpec sharding ``x``'s largest divisible dim over ``axes``.
+    With ``base`` (an existing PartitionSpec), already-sharded dims are kept
+    and skipped by the selection — the hybrid (mp/pp + ZeRO) composition."""
     if not hasattr(x, 'shape') or getattr(x, 'ndim', 0) == 0 or deg <= 1:
-        return PartitionSpec()
+        return PartitionSpec(*base) if base is not None else PartitionSpec()
+    parts = (list(base) + [None] * (x.ndim - len(base))
+             if base is not None else [None] * x.ndim)
     best = None
     for d, s in enumerate(x.shape):
-        if s % deg == 0 and s >= deg and (best is None or s > x.shape[best]):
+        if (parts[d] is None and s % deg == 0 and s >= deg
+                and (best is None or s > x.shape[best])):
             best = d
     if best is None:
-        return PartitionSpec()
-    parts = [None] * x.ndim
+        return PartitionSpec(*parts)
     parts[best] = axes if len(axes) > 1 else axes[0]
     return PartitionSpec(*parts)
 
@@ -82,6 +86,19 @@ def place(tree, mesh=None, axes=('dp',)):
         except Exception:
             return x
     return jax.tree_util.tree_map(put, tree, specs)
+
+
+def hybrid_zero3_specs(tree, base_specs, mesh=None, dp_axis='dp'):
+    """Merge ZeRO-3 dp sharding INTO an existing mp/pp spec tree: each leaf
+    keeps its Megatron/pipeline axes and additionally shards its largest
+    still-unsharded divisible dim over ``dp_axis`` — the declarative form
+    of the reference's sharding-optimizer x megatron composition (10B
+    hybrid layout; see distributed/scale_plan.py)."""
+    mesh = mesh or get_mesh()
+    deg = mesh.shape.get(dp_axis, 1)
+    return jax.tree_util.tree_map(
+        lambda x, spec: shard_spec(x, deg, (dp_axis,), base=spec),
+        tree, base_specs)
 
 
 def make_zero_train_step(loss_fn, optimizer, mesh=None, stage=1,
