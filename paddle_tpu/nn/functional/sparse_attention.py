@@ -50,10 +50,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """query/key/value: [batch, seq, heads, head_dim] (paddle layout).
 
-    Attention dropout (dropout_p > 0 while training) routes to the XLA
-    path — the pallas flash kernels do not sample dropout, and silently
-    skipping it would train a different model than the user asked for
-    (journey r4b: dropout_p was previously accepted and IGNORED)."""
+    Attention dropout (dropout_p > 0 while training) stays ON the flash
+    path: the pallas kernels sample an in-kernel counter-hash mask
+    (ops/flash_attention._dropout_keep) regenerated identically in the
+    backward — the reference keeps dropout fused too
+    (fused_attention_op.cc). dropout_p >= 1 (degenerate all-dropped)
+    routes to the XLA path's zero-output semantics."""
     hook = dispatch.amp_cast_hook
     if hook is not None:
         query, key, value = hook('scaled_dot_product_attention',
@@ -65,7 +67,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     qv, kv, vv = (getattr(t, '_value', t) for t in (query, key, value))
     mv = getattr(attn_mask, '_value', attn_mask)
     use_flash = False
-    if drop == 0.0:
+    if drop < 1.0:
         try:
             from ...ops.flash_attention import flash_attention_available
             use_flash = flash_attention_available(qv, kv, vv, mv)
@@ -74,12 +76,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # the key is drawn OUTSIDE apply_op so the tape's vjp replay sees the
     # same mask the forward sampled (the F.dropout pattern)
     rng = next_key() if drop else None
+    # u32 seed for the in-kernel mask, derived once per call from the same
+    # stream (traced: varies per step under jit without retracing)
+    seed = jax.random.bits(rng, (1,), jnp.uint32) if (drop and use_flash) \
+        else None
 
     def pure(q, k, v, *m):
         mask = m[0] if m else None
         if use_flash:
             from ...ops.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=is_causal, mask=mask)
+            return flash_attention(q, k, v, causal=is_causal, mask=mask,
+                                   dropout_rate=drop, dropout_seed=seed)
         return _sdpa_xla(q, k, v, mask=mask, causal=is_causal,
                          dropout_p=drop, rng=rng)
 

@@ -170,17 +170,61 @@ def _mask_scores(s, causal, qi_or_qb, kb, bq, bk, q_off, kv_valid):
     return s
 
 
-def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
+def _dropout_keep(seed, row, q_pos, k_pos, rate):
+    """Deterministic counter-based attention-dropout mask (VERDICT r5 #5):
+    a murmur3-style integer finalizer hashed from (seed, attention row,
+    query position, key position) -> bool keep tile with P(keep) = 1-rate.
+    The SAME pure function runs inside the pallas kernels (VPU integer
+    ops; no PRNG state) and in the jnp fallback/backward, so forward and
+    both backward kernels regenerate bit-identical masks without ever
+    storing an S_q x S_k mask in HBM — the TPU answer to the reference's
+    fused attention dropout (fused_attention_op.cc keeps dropout fused).
+
+    seed: traced u32 scalar; row: i32/u32 scalar (B*H program row);
+    q_pos/k_pos: i32 tiles of GLOBAL positions; rate: static python float.
+    """
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         + jnp.asarray(row, jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+         + jnp.asarray(seed, jnp.uint32))
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    # top-24-bit uniform vs the rate threshold (exact for rate in [0,1])
+    return (x >> jnp.uint32(8)).astype(jnp.float32) >= _np.float32(
+        rate * (1 << 24))
+
+
+def _drop_mult(shape, seed, row, qb, kb, bq, bk, rate):
+    """[BQ, BK] f32 dropout multiplier tile: 1/(1-rate) kept, 0 dropped.
+    Tile coordinates are converted to GLOBAL q/k positions so forward and
+    backward agree regardless of how each kernel blocks the sequence."""
+    q_pos = qb * bq + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    keep = _dropout_keep(seed, row, q_pos, k_pos, rate)
+    return jnp.where(keep, _np.float32(1.0 / (1.0 - rate)),
+                     _np.float32(0.0))
+
+
+def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask,
+                drop_rate=0.0):
     # Scalar constants pinned to f32 (Mosaic rejects f64). MXU dtype policy:
     # q/k/v stay in their NATIVE dtype for the dot_generals (bf16 inputs run
     # the MXU at full rate) with f32 accumulation via preferred_element_type;
     # the softmax scale is applied to the f32 scores AFTER the dot, so no
     # precision is lost to a bf16 pre-scale.
+    if drop_rate:
+        seed_ref, refs = refs[-3], refs[:-3] + refs[-2:]
     if has_kmask:
         q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref = refs
     qi = pl.program_id(1)
+    # program_id must be read OUTSIDE the fori_loop body (the interpret-mode
+    # lowering can't resolve it inside the loop's inner jaxpr)
+    bh_row = pl.program_id(0) if drop_rate else None
     q = q_ref[0]                                            # [BQ, D] native
     s_total = k_ref.shape[1]
     nkb = s_total // bk
@@ -203,7 +247,12 @@ def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [BQ,1]
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)                                   # [BQ,1]
+        # the softmax normalizer accumulates the UNdropped p (dropout acts
+        # on the post-softmax probabilities, not inside the softmax)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if drop_rate:
+            p = p * _drop_mult(p.shape, seed_ref[0], bh_row,
+                               qi, kb, bq, bk, drop_rate)
         # p cast to v's dtype: bf16×bf16→f32 keeps the MXU at full rate;
         # identity for f32 inputs
         acc = acc * alpha + jax.lax.dot_general(
@@ -225,12 +274,13 @@ def _fwd_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
 
 
 def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
-               g=1, bq=None, bk=None):
+               g=1, bq=None, bk=None, drop_rate=0.0, seed=None):
     """q: [BH, S_q, D]; k/v: [BH//g, S_k, D] (g = query-group size, GQA)
     -> (out [BH,S_q,D], lse [BH,S_q]). Each kv row serves its g query heads
     via the block index map — repeated KV is never materialized.
     kmask: additive f32 [B, S_k] (BH = B*h, mask row b//h) or None.
-    bq/bk: block rows (must divide s_q/s_k); auto-picked when None."""
+    bq/bk: block rows (must divide s_q/s_k); auto-picked when None.
+    drop_rate/seed: in-kernel attention dropout (seed: u32[1], SMEM)."""
     bh, s_q, d = q.shape
     s_k = int(k.shape[1])
     if bq is None or bk is None:
@@ -239,7 +289,8 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
     grid = (bh, s_q // bq)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                bq=bq, bk=bk, q_off=q_off, kv_valid=kv_valid,
-                               has_kmask=kmask is not None)
+                               has_kmask=kmask is not None,
+                               drop_rate=drop_rate)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i: (b, i, _np.int32(0))),
         pl.BlockSpec((1, s_k, d),
@@ -253,6 +304,9 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
             (1, 1, s_k),
             lambda b, i: (b // h, _np.int32(0), _np.int32(0))))
         args.append(kmask[:, None, :])
+    if drop_rate:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.uint32).reshape(1))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -271,7 +325,8 @@ def _flash_fwd(q, k, v, causal, q_off=0, kv_valid=None, kmask=None, h=1,
 
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                   kmask=None, h=1, groups=1, bk=None):
+                   kmask=None, h=1, groups=1, bk=None, drop_rate=0.0,
+                   seed=None):
     """Blockwise gradients (scan over k-blocks), fp32 accumulation.
     GQA (groups>1): kv repeated across the group here (fallback path),
     group-partial dk/dv summed at the end."""
@@ -280,7 +335,8 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
         vx = jnp.repeat(v, groups, axis=0)
         dq, dkp, dvp = _bwd_blockwise(q, kx, vx, out, lse, g, causal,
                                       q_off=q_off, kv_valid=kv_valid,
-                                      kmask=kmask, h=h, bk=bk)
+                                      kmask=kmask, h=h, bk=bk,
+                                      drop_rate=drop_rate, seed=seed)
         shp = (k.shape[0], groups) + tuple(k.shape[1:])
         dk = dkp.astype(jnp.float32).reshape(shp).sum(1).astype(k.dtype)
         dv = dvp.astype(jnp.float32).reshape(shp).sum(1).astype(v.dtype)
@@ -317,8 +373,20 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
         if kv_valid is not None:
             sc = jnp.where((kp < kv_valid)[None, None], sc, -1e30)
         p = jnp.exp(sc - lse[:, :, None])                  # [BH,S_q,BK]
-        dv = jnp.einsum('bqk,bqd->bkd', p, gf)
+        if drop_rate:
+            keep = _dropout_keep(
+                jnp.asarray(seed, jnp.uint32).reshape(()),
+                jnp.arange(p.shape[0], dtype=jnp.uint32)[:, None, None],
+                q_pos[None, :, None], kp[None, None, :], drop_rate)
+            mult = jnp.where(keep, _np.float32(1.0 / (1.0 - drop_rate)),
+                             _np.float32(0.0))
+            pd, dpm = p * mult, mult
+        else:
+            pd, dpm = p, None
+        dv = jnp.einsum('bqk,bqd->bkd', pd, gf)
         dp = jnp.einsum('bqd,bkd->bqk', gf, vblk)
+        if dpm is not None:
+            dp = dp * dpm
         ds = p * (dp - delta[:, :, None])
         dq = dq + jnp.einsum('bqk,bkd->bqd', ds, kblk) * scale
         dk = jnp.einsum('bqk,bqd->bkd', ds, qf)
@@ -331,17 +399,24 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _bwd_dq_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
+def _bwd_dq_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask,
+                   drop_rate=0.0):
     """dq: each program owns one q block, streams k/v blocks.
 
     Recomputes p = exp(s - lse) from the saved row log-sum-exp; constants
-    pinned f32/i32 for Mosaic (see forward kernel notes).
+    pinned f32/i32 for Mosaic (see forward kernel notes). With dropout the
+    counter-hash mask is regenerated per tile (ds = p * (drop(dp) - delta):
+    delta = rowsum(g*out) already equals sum_k p*dP under dropout, so the
+    flash-backward identity is unchanged).
     """
+    if drop_rate:
+        seed_ref, refs = refs[-2], refs[:-2] + refs[-1:]
     if has_kmask:
         q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, kmask_ref, dq_ref = refs
     else:
         q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, dq_ref = refs
     qi = pl.program_id(1)
+    bh_row = pl.program_id(0) if drop_rate else None   # see _fwd_kernel note
     q = q_ref[0]                                               # [BQ, D] native
     g = g_ref[0]                                               # [BQ, D]
     lse = lse_ref[0][:, :1]                                    # [BQ, 1]
@@ -362,6 +437,9 @@ def _bwd_dq_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
         p = jnp.exp(s - lse)                                   # [BQ, BK] f32
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_rate:
+            dp = dp * _drop_mult(dp.shape, seed_ref[0], bh_row,
+                                 qi, kb, bq, bk, drop_rate)
         ds = (p * (dp - delta)).astype(kblk.dtype)
         dq = dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -373,14 +451,18 @@ def _bwd_dq_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
     dq_ref[0] = (dq * _np.float32(scale)).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
+def _bwd_dkv_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask,
+                    drop_rate=0.0):
     """dk/dv: each program owns one k/v block, streams q blocks."""
+    if drop_rate:
+        seed_ref, refs = refs[-3], refs[:-3] + refs[-2:]
     if has_kmask:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, kmask_ref,
          dk_ref, dv_ref) = refs
     else:
         q_ref, k_ref, v_ref, g_ref, lse_ref, dta_ref, dk_ref, dv_ref = refs
     ki = pl.program_id(1)
+    bh_row = pl.program_id(0) if drop_rate else None   # see _fwd_kernel note
     kblk = k_ref[0]                                            # [BK, D] native
     vblk = v_ref[0]
     nqb = q_ref.shape[1] // bq
@@ -403,11 +485,19 @@ def _bwd_dkv_kernel(*refs, causal, scale, bq, bk, q_off, kv_valid, has_kmask):
             s = s + km
         s = _mask_scores(s, causal, qb, ki, bq, bk, q_off, kv_valid)
         p = jnp.exp(s - lse)                                   # [BQ, BK] f32
-        dv = dv + jax.lax.dot_general(p.astype(g.dtype), g,
+        if drop_rate:
+            mult = _drop_mult(p.shape, seed_ref[0], bh_row,
+                              qb, ki, bq, bk, drop_rate)
+            pd = p * mult                    # dropped probs: out = pd @ v
+        else:
+            pd = p
+        dv = dv + jax.lax.dot_general(pd.astype(g.dtype), g,
                                       (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_rate:
+            dp = dp * mult
         ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -436,16 +526,19 @@ def bwd_broadcasts(out, lse, g):
 
 
 def _bwd_pallas(q, k, v, out, lse, g, causal, q_off=0, kv_valid=None,
-                kmask=None, h=1, groups=1, bq=None, bk=None):
+                kmask=None, h=1, groups=1, bq=None, bk=None, drop_rate=0.0,
+                seed=None):
     """Flash backward via the two-kernel pallas split; fp32 accumulation."""
     lse_b, dta_b = bwd_broadcasts(out, lse, g)
     return _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=q_off,
                            kv_valid=kv_valid, kmask=kmask, h=h,
-                           groups=groups, bq=bq, bk=bk)
+                           groups=groups, bq=bq, bk=bk, drop_rate=drop_rate,
+                           seed=seed)
 
 
 def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
-                    kmask=None, h=1, groups=1, bq=None, bk=None):
+                    kmask=None, h=1, groups=1, bq=None, bk=None,
+                    drop_rate=0.0, seed=None):
     """Backward kernels with the lse/delta broadcasts precomputed.
 
     GQA (groups>1): k/v have BH//groups rows. dq streams the shared kv row
@@ -476,14 +569,19 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
         pl.BlockSpec((1, _BQ, _LANES), blk),     # lse
         pl.BlockSpec((1, _BQ, _LANES), blk),     # delta
     ]
+    seed_arr = (jnp.asarray(seed, jnp.uint32).reshape(1) if drop_rate
+                else None)
     dq_args = [q, k, v, g, lse_b, dta_b]
     if has_kmask:
         dq_in_specs.append(pl.BlockSpec((1, 1, s_k), mrow3))
         dq_args.append(kmask3)
+    if drop_rate:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_args.append(seed_arr)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           bq=_BQ, bk=_BK, q_off=q_off, kv_valid=kv_valid,
-                          has_kmask=has_kmask),
+                          has_kmask=has_kmask, drop_rate=drop_rate),
         grid=(bh, s_q // _BQ),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, _BQ, d), blk),
@@ -503,10 +601,13 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     if has_kmask:
         dkv_in_specs.append(pl.BlockSpec((1, 1, s_k), mrow3))
         dkv_args.append(kmask3)
+    if drop_rate:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_args.append(seed_arr)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           bq=_BQ, bk=_BK, q_off=q_off, kv_valid=kv_valid,
-                          has_kmask=has_kmask),
+                          has_kmask=has_kmask, drop_rate=drop_rate),
         grid=(bh, s_k // _BK),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -526,31 +627,40 @@ def _bwd_pallas_pre(q, k, v, g, lse_b, dta_b, causal, q_off=0, kv_valid=None,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, kmask, causal, q_off, kv_valid, h, groups, bq, bk):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, kmask, seed, causal, q_off, kv_valid, h, groups, bq, bk,
+           drop_rate):
     out, _ = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                        kmask=kmask, h=h, g=groups, bq=bq, bk=bk)
+                        kmask=kmask, h=h, g=groups, bq=bq, bk=bk,
+                        drop_rate=drop_rate, seed=seed)
     return out
 
 
-def _flash_f(q, k, v, kmask, causal, q_off, kv_valid, h, groups, bq, bk):
+def _flash_f(q, k, v, kmask, seed, causal, q_off, kv_valid, h, groups, bq,
+             bk, drop_rate):
     out, lse = _flash_fwd(q, k, v, causal, q_off=q_off, kv_valid=kv_valid,
-                          kmask=kmask, h=h, g=groups, bq=bq, bk=bk)
-    return out, (q, k, v, kmask, out, lse)
+                          kmask=kmask, h=h, g=groups, bq=bq, bk=bk,
+                          drop_rate=drop_rate, seed=seed)
+    return out, (q, k, v, kmask, seed, out, lse)
 
 
-def _flash_b(causal, q_off, kv_valid, h, groups, bq, bk, res, g):
-    q, k, v, kmask, out, lse = res
+def _flash_b(causal, q_off, kv_valid, h, groups, bq, bk, drop_rate, res, g):
+    q, k, v, kmask, seed, out, lse = res
     if os.environ.get('PADDLE_TPU_FLASH_JNP_BWD') == '1':
         dq, dk, dv = _bwd_blockwise(q, k, v, out, lse, g, causal,
                                     q_off=q_off, kv_valid=kv_valid,
-                                    kmask=kmask, h=h, groups=groups, bk=bk)
+                                    kmask=kmask, h=h, groups=groups, bk=bk,
+                                    drop_rate=drop_rate, seed=seed)
     else:
         dq, dk, dv = _bwd_pallas(q, k, v, out, lse, g, causal, q_off=q_off,
                                  kv_valid=kv_valid, kmask=kmask, h=h,
-                                 groups=groups, bq=bq, bk=bk)
+                                 groups=groups, bq=bq, bk=bk,
+                                 drop_rate=drop_rate, seed=seed)
     dmask = None if kmask is None else jnp.zeros_like(kmask)
-    return dq, dk, dv, dmask
+    # integer primal (the dropout seed): float0 cotangent per custom_vjp
+    dseed = _np.zeros(jnp.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dmask, dseed
 
 
 _flash.defvjp(_flash_f, _flash_b)
@@ -586,8 +696,11 @@ def repeat_kv(k, v, n_q_heads):
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
-def _jnp_attention(q, k, v, causal, mask):
-    """XLA-softmax fallback for shapes the kernels decline ([B,S,H,D])."""
+def _jnp_attention(q, k, v, causal, mask, drop_rate=0.0, seed=None):
+    """XLA-softmax fallback for shapes the kernels decline ([B,S,H,D]).
+    With ``drop_rate``, applies the SAME counter-hash dropout mask as the
+    kernels (row = b*H + h of the flattened layout), so kernel/fallback
+    parity holds element-for-element and is testable off-chip."""
     k, v = repeat_kv(k, v, int(q.shape[2]))
     d = q.shape[-1]
     scores = jnp.einsum('bqhd,bkhd->bhqk', q, k).astype(jnp.float32)
@@ -602,11 +715,23 @@ def _jnp_attention(q, k, v, causal, mask):
             scores = jnp.where(m, scores, _NEG_INF)
         else:
             scores = scores + m.astype(jnp.float32)
-    p = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    p = jax.nn.softmax(scores, axis=-1)
+    if drop_rate:
+        b, h, s_q2, s_k2 = p.shape
+        row = (jnp.arange(b * h, dtype=jnp.uint32)
+               .reshape(b, h)[:, :, None, None])
+        q_pos = jnp.arange(s_q2, dtype=jnp.int32)[None, None, :, None]
+        k_pos = jnp.arange(s_k2, dtype=jnp.int32)[None, None, None, :]
+        keep = _dropout_keep(jnp.asarray(seed, jnp.uint32).reshape(()),
+                             row, q_pos, k_pos, drop_rate)
+        p = jnp.where(keep, p * _np.float32(1.0 / (1.0 - drop_rate)),
+                      _np.float32(0.0))
+    p = p.astype(v.dtype)
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
-def flash_attention(q, k, v, causal=False, mask=None):
+def flash_attention(q, k, v, causal=False, mask=None, dropout_rate=0.0,
+                    dropout_seed=None):
     """q: [B, S_q, H, D]; k/v: [B, S_k, H, D] (paddle layout) -> [B,S_q,H,D].
 
     mask: optional KEY-PADDING mask — bool (True = attend) or additive
@@ -614,13 +739,26 @@ def flash_attention(q, k, v, causal=False, mask=None):
     cross-attention uses the aligned-ends convention (query i attends keys
     <= S_k - S_q + i). Shapes the kernels decline (see
     ``flash_attention_available``) fall back to the XLA softmax path, so
-    this op is always safe to call."""
+    this op is always safe to call.
+
+    dropout_rate/dropout_seed: IN-KERNEL attention dropout on the
+    post-softmax probabilities (inverted scaling); ``dropout_seed`` is a
+    u32 scalar/[1] array (traced — vary it per step) hashed per
+    (row, q, k) element by ``_dropout_keep``, so fwd and bwd regenerate
+    the mask instead of storing it. rate >= 1 is rejected (use the jnp
+    path's all-dropped semantics via scaled_dot_product_attention)."""
+    drop = float(dropout_rate or 0.0)
+    if drop >= 1.0:
+        raise ValueError('flash_attention dropout_rate must be < 1')
+    if drop > 0.0 and dropout_seed is None:
+        raise ValueError('dropout_rate > 0 requires dropout_seed')
     b, s_q, hh, d = q.shape
     s_k = int(k.shape[1])
     h_kv = int(k.shape[2])
     if (not flash_attention_available(q, k, v, mask)
             or (causal and s_q > s_k)):
-        return _jnp_attention(q, k, v, causal, mask)
+        return _jnp_attention(q, k, v, causal, mask, drop_rate=drop,
+                              seed=dropout_seed)
     groups = hh // h_kv
 
     kmask = (_normalize_key_mask(mask, b, s_k)
@@ -645,8 +783,10 @@ def flash_attention(q, k, v, causal=False, mask=None):
         else:
             kv_valid = s_k          # static in-kernel bound, no mask array
 
-    out = _flash(qt, kt, vt, kmask, causal, q_off, kv_valid, hh, groups,
-                 bq, bk)
+    seed_arr = (jnp.asarray(dropout_seed, jnp.uint32).reshape(1) if drop
+                else jnp.zeros((1,), jnp.uint32))
+    out = _flash(qt, kt, vt, kmask, seed_arr, causal, q_off, kv_valid, hh,
+                 groups, bq, bk, drop)
     out = out[:, :s_q]
     return out.reshape(b, hh, s_q, d).transpose(0, 2, 1, 3)
 

@@ -564,3 +564,165 @@ def test_pick_blocks_env_cap(monkeypatch):
     monkeypatch.setattr(fa, '_BK_CAP', 384)
     bq, bk = fa._pick_blocks(512, 768)
     assert bq % bk == 0 and bk >= 128
+
+
+# ---- in-kernel attention dropout (VERDICT r5 #5) ---------------------------
+
+def _naive_dropout(q, k, v, causal, rate, seed):
+    """Reference: softmax then the SAME counter-hash mask the kernels use
+    (fa._dropout_keep over the flattened [B*H, S_q, S_k] rows)."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    kx, vx = fa.repeat_kv(k, v, h)
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = kx.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = vx.transpose(0, 2, 1, 3).astype(jnp.float32)
+    sc = jnp.einsum('bhqd,bhkd->bhqk', qt, kt) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        sc = jnp.where(mask, sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    row = jnp.arange(b * h, dtype=jnp.uint32).reshape(b, h)[:, :, None, None]
+    q_pos = jnp.arange(s_q, dtype=jnp.int32)[None, None, :, None]
+    k_pos = jnp.arange(s_k, dtype=jnp.int32)[None, None, None, :]
+    keep = fa._dropout_keep(jnp.uint32(seed), row, q_pos, k_pos, rate)
+    p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    out = jnp.einsum('bhqk,bhkd->bhqd', p, vt)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def test_dropout_keep_rate_statistics():
+    """P(keep) ~= 1-rate and masks decorrelate across seeds."""
+    q_pos = jnp.arange(256, dtype=jnp.int32)[:, None]
+    k_pos = jnp.arange(256, dtype=jnp.int32)[None, :]
+    for rate in (0.1, 0.5):
+        m = fa._dropout_keep(jnp.uint32(7), jnp.uint32(3), q_pos, k_pos,
+                             rate)
+        assert abs(float(jnp.mean(m)) - (1 - rate)) < 0.02, rate
+    m1 = fa._dropout_keep(jnp.uint32(1), jnp.uint32(0), q_pos, k_pos, 0.5)
+    m2 = fa._dropout_keep(jnp.uint32(2), jnp.uint32(0), q_pos, k_pos, 0.5)
+    agree = float(jnp.mean(m1 == m2))
+    assert 0.4 < agree < 0.6          # independent masks agree ~50%
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_dropout_forward_parity(causal):
+    """Kernel dropout == softmax + identical hash mask, element-exact."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 256, 2, 64)
+    got = fa.flash_attention(q, k, v, causal=causal, dropout_rate=0.3,
+                             dropout_seed=42)
+    want = _naive_dropout(q, k, v, causal, 0.3, 42)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_dropout_grad_parity():
+    """Pallas backward kernels regenerate the same mask: grads match the
+    jnp reference with the explicit mask."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 256, 2, 64)
+
+    def loss_flash(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True, dropout_rate=0.25,
+                                  dropout_seed=7).sum()
+
+    def loss_ref(q, k, v):
+        return _naive_dropout(q, k, v, True, 0.25, 7).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_dropout_grad_parity_jnp_bwd(monkeypatch):
+    """The blockwise jnp fallback backward regenerates the same mask too."""
+    monkeypatch.setenv('PADDLE_TPU_FLASH_JNP_BWD', '1')
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 256, 2, 64)
+
+    def loss_flash(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True, dropout_rate=0.25,
+                                  dropout_seed=9).sum()
+
+    def loss_ref(q, k, v):
+        return _naive_dropout(q, k, v, True, 0.25, 9).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_dropout_gqa_parity():
+    """GQA + dropout: shared kv rows, per-query-head masks."""
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(3), 2, 256, 4, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(4), 2, 256, 2, 64)
+    got = fa.flash_attention(q, k, v, causal=True, dropout_rate=0.2,
+                             dropout_seed=11)
+    want = _naive_dropout(q, k, v, True, 0.2, 11)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_dropout_seed_varies_and_traced():
+    """Different seeds -> different outputs; a TRACED seed does not
+    retrace (one compiled program serves every step's mask)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 256, 2, 64)
+    f = jax.jit(lambda s: fa.flash_attention(
+        q, k, v, causal=True, dropout_rate=0.4, dropout_seed=s))
+    o1 = f(jnp.asarray([1], jnp.uint32))
+    o2 = f(jnp.asarray([2], jnp.uint32))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    assert f._cache_size() == 1
+
+
+def test_sdpa_keeps_flash_path_under_dropout(monkeypatch):
+    """scaled_dot_product_attention no longer declines dropout>0 (VERDICT
+    r4 weak #8): the flash kernel is invoked, training stats hold, and
+    grads flow."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    calls = {}
+    real = fa.flash_attention
+
+    def spy(*a, **kw):
+        calls['dropout_rate'] = kw.get('dropout_rate')
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa, 'flash_attention', spy)
+    q = paddle.to_tensor(np.random.rand(1, 256, 2, 64).astype('f4'))
+    q.stop_gradient = False
+    out = F.scaled_dot_product_attention(q, q, q, dropout_p=0.3,
+                                         is_causal=True, training=True)
+    assert calls.get('dropout_rate') == 0.3
+    out.sum().backward()
+    assert np.isfinite(np.asarray(q.grad._value)).all()
+
+
+@pytest.mark.parametrize('s', [384, 200])
+def test_dropout_multiblock_and_padded_parity(s):
+    """Multi-tile (s=384 -> 128-row blocks) and padded (s=200) sequences:
+    guards the tile-to-GLOBAL position reconstruction in _drop_mult — a
+    local-coordinate bug would pass at s=256 (one tile) but corrupt every
+    multi-block mask (review r5b)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), 2, s, 2, 64)
+
+    def loss_flash(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                                  dropout_seed=13).sum()
+
+    def loss_ref(q, k, v):
+        return _naive_dropout(q, k, v, True, 0.3, 13).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v, causal=True,
+                                      dropout_rate=0.3, dropout_seed=13)),
+        np.asarray(_naive_dropout(q, k, v, True, 0.3, 13)),
+        atol=3e-5, rtol=3e-5)
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)

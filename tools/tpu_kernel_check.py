@@ -201,6 +201,29 @@ def main():
                    *[float(maxdiff(a, c)) for a, c in zip(g1, g2)])
     check('blockwise_xent', xent_check, 2e-3)
 
+    # -- in-kernel attention dropout (r5): fwd + bwd mask regen -----------
+    def dropout_fwd():
+        got = fa.flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                                 dropout_seed=42)
+        want = fa._jnp_attention(q, k, v, True, None, drop_rate=0.3,
+                                 seed=42)
+        return maxdiff(got, want)
+    check('dropout_fwd', dropout_fwd, 2e-2)
+
+    def dropout_grad():
+        def lf(q, k, v):
+            return jnp.sum(fa.flash_attention(
+                q, k, v, causal=True, dropout_rate=0.25,
+                dropout_seed=7) ** 2)
+
+        def lr(q, k, v):
+            return jnp.sum(fa._jnp_attention(
+                q, k, v, True, None, drop_rate=0.25, seed=7) ** 2)
+        g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        return max(float(maxdiff(a, c)) for a, c in zip(g1, g2))
+    check('dropout_grad', dropout_grad, 2e-2)
+
     all_ok = all(r.get('ok') for r in results.values())
     print(json.dumps({'tpu_kernel_checks': results, 'all_ok': all_ok,
                       'platform': platform}))
