@@ -37,6 +37,11 @@ _ELEMENTWISE = {
     'integer_pow', 'is_finite', 'select_n', 'nextafter', 'clamp',
     'eq', 'ne', 'lt', 'le', 'gt', 'ge', 'convert_element_type',
     'stop_gradient', 'copy', 'real', 'imag', 'square',
+    # remat 'dots' policy marks saved matmul outputs with reduce_precision,
+    # and grad accumulation sums cotangents with add_any — both are
+    # shape-preserving elementwise ops; without them every train-step
+    # completion died at the first saved dot (r5 flagship closure)
+    'reduce_precision', 'add_any',
 }
 _REDUCE = {'reduce_sum', 'reduce_max', 'reduce_min', 'reduce_prod',
            'reduce_and', 'reduce_or', 'argmax', 'argmin'}
@@ -203,6 +208,44 @@ def _inner_jaxpr(eqn):
     return None
 
 
+def _flash_pallas_sig(eqn):
+    """Classify an in-tree flash-attention ``pallas_call`` by its aval
+    signature (r5: the kernels carry no name in params, and recursing into
+    a kernel jaxpr of Refs is meaningless for specs). Matches the three
+    training kernels of ops/flash_attention.py:
+
+      'fwd': inputs [q,k,v,(kmask),(seed)], outputs [out(q-shaped),
+             lse(q[:2]+(128,))]
+      'dq' : >=6 inputs [q,k,v,g,lse,dta,...], one q-shaped output
+      'dkv': >=6 inputs, two outputs shaped like q rows x k columns
+
+    Decode kernels lead with a scalar-prefetch position arg (first invar
+    rank != 3) and are inference-only: classified None, which soundly
+    stops propagation."""
+    ins, outs = eqn.invars, eqn.outvars
+    if not ins or _aval_ndim(ins[0]) != 3 or len(ins) < 3:
+        return None
+    q = _aval_shape(ins[0])
+    if (len(outs) == 2 and _aval_shape(outs[0]) == q
+            and _aval_shape(outs[1]) == q[:2] + (128,) and len(ins) <= 5):
+        return 'fwd'
+    if len(ins) >= 6 and len(outs) == 1 and _aval_shape(outs[0]) == q:
+        return 'dq'
+    if (len(ins) >= 6 and len(outs) == 2 and _aval_ndim(ins[1]) == 3
+            and _aval_shape(outs[0]) == _aval_shape(outs[1])
+            and _aval_shape(outs[0])[1:] == _aval_shape(ins[1])[1:]):
+        return 'dkv'
+    return None
+
+
+def _size_matched(spec, src_shape, dst_shape):
+    """Carry an axis across only where both sides have the SAME extent on
+    that dim (GQA shrinks the kv row dim by the group factor — an axis on
+    a mismatched dim would over-claim)."""
+    return [a if (d < len(dst_shape) and src_shape[d] == dst_shape[d])
+            else None for d, a in enumerate(spec)]
+
+
 class _Planner:
     def __init__(self, conflicts):
         self.conflicts = conflicts
@@ -327,8 +370,74 @@ class _Planner:
                             for d, a in enumerate(s)], where)
         elif name == 'scan':
             self._scan(eqn, env)
+        elif name == 'pallas_call':
+            self._pallas_fwd(eqn, env)
         elif _inner_jaxpr(eqn) is not None:
             self._call(eqn, env)
+
+    # ---- pallas flash kernels (r5: VERDICT item 7) ----------------------
+    # Pass specs THROUGH the kernel boundary instead of recursing into the
+    # Ref-typed kernel jaxpr. q rows map 1:1 to out rows; dq to q; dk/dv to
+    # k/v. The head-merge reshape feeding the kernel ([B,H,S,D]->[B*H,S,D])
+    # is a separate, known representational limit: a PartitionSpec cannot
+    # express "the H factor of the merged dim is sharded", so a
+    # head-sharded ('mp') flash model still needs the attention projection
+    # weight seeded (see tests/test_auto_parallel_planner.py flash test).
+    def _pallas_fwd(self, eqn, env):
+        sig = _flash_pallas_sig(eqn)
+        if sig is None:
+            return
+        where = f'flash-{sig}'
+        if sig == 'fwd':
+            # out rows follow q rows; out's LAST dim is v-derived (q/k's D
+            # is contracted away) so it is not carried from q (review r5c)
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                env.update(eqn.outvars[0], (s[0], s[1], None), where)
+                env.update(eqn.outvars[1], (s[0], s[1], None), where)
+            sv = env.get(eqn.invars[2])
+            if sv is not None:
+                env.update(eqn.outvars[0],
+                           (None, None, sv[2]), where)
+        elif sig == 'dq':
+            s = env.get(eqn.invars[0])
+            if s is not None:
+                env.update(eqn.outvars[0], s, where)
+        else:                                     # dkv
+            for i, o in ((1, 0), (2, 1)):
+                s = env.get(eqn.invars[i])
+                if s is not None:
+                    env.update(eqn.outvars[o], _size_matched(
+                        s, _aval_shape(eqn.invars[i]),
+                        _aval_shape(eqn.outvars[o])), where)
+
+    def _pallas_bwd(self, eqn, env):
+        sig = _flash_pallas_sig(eqn)
+        if sig is None:
+            return
+        where = f'flash-{sig}<-'
+        if sig == 'fwd':
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                # q/k do not share out's v-derived last dim (review r5c)
+                env.update(eqn.invars[0], (s[0], s[1], None), where)
+                env.update(eqn.invars[1], _size_matched(
+                    (s[0], None, None), _aval_shape(eqn.outvars[0]),
+                    _aval_shape(eqn.invars[1])), where)
+                env.update(eqn.invars[2], _size_matched(
+                    (s[0], None, s[2]), _aval_shape(eqn.outvars[0]),
+                    _aval_shape(eqn.invars[2])), where)
+        elif sig == 'dq':
+            s = env.get(eqn.outvars[0])
+            if s is not None:
+                env.update(eqn.invars[0], s, where)
+        else:                                     # dkv
+            for i, o in ((1, 0), (2, 1)):
+                s = env.get(eqn.outvars[o])
+                if s is not None:
+                    env.update(eqn.invars[i], _size_matched(
+                        s, _aval_shape(eqn.outvars[o]),
+                        _aval_shape(eqn.invars[i])), where)
 
     # ---- one equation, backward (outputs known -> infer inputs) --------
     def bwd(self, eqn, env):
@@ -450,6 +559,8 @@ class _Planner:
                             for d, a in enumerate(s)], where)
         elif name == 'scan':
             self._scan(eqn, env)
+        elif name == 'pallas_call':
+            self._pallas_bwd(eqn, env)
         elif _inner_jaxpr(eqn) is not None:
             self._call(eqn, env)
 

@@ -270,3 +270,120 @@ def test_cnn_dp_completion_and_apply():
         args = plan.place((pd, x, y), mesh)
         out = step(*args)
     assert np.isfinite(float(out))
+
+
+def test_flagship_flash_train_step_planner_driven():
+    """r5 (VERDICT item 7): the planner closes the loop on the REAL
+    flagship shape — flash custom_vjp + lax.scan over layers + remat in
+    ONE train step. Completion runs from seeds (+ proj_w: the head-merge
+    reshape feeding the kernels is a documented representational limit —
+    a PartitionSpec cannot carry 'the H factor of B*H is sharded'),
+    plan.apply executes the step on the 8-device dp4 x mp2 mesh, and the
+    numerics match the hand-sharded step exactly."""
+    import importlib
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+    fa.set_interpret(True)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': 4, 'mp_degree': 2}
+        topo = fleet.init(is_collective=True, strategy=strategy)
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=128, num_layers=2,
+                            num_heads=2, max_seq_len=128, dtype='float32',
+                            use_flash=True, remat=True, mp=2, xent_chunk=0)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        opt_state = opt.functional_init(params)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 128), 0,
+                                  cfg.vocab_size)
+        lr = jnp.asarray(1e-3)
+
+        def step(params, opt_state, toks):
+            loss, grads = jax.value_and_grad(gpt.loss_fn)(params, toks,
+                                                          toks, cfg)
+            new_p, new_s = opt.functional_apply(params, grads, opt_state,
+                                                lr)
+            return loss, new_p, new_s
+
+        seeds_p = jax.tree_util.tree_map(lambda _: None, params)
+        seeds_p['wte'] = P('mp', None)
+        seeds_p['blocks']['qkv_w'] = P(None, None, 'mp')
+        seeds_p['blocks']['fc_w'] = P(None, None, 'mp')
+        seeds_p['blocks']['proj_w'] = P(None, 'mp', None)
+        seeds_s = jax.tree_util.tree_map(lambda _: None, opt_state)
+        plan = complete_shardings(step, (params, opt_state, toks),
+                                  (seeds_p, seeds_s, P('dp', None)))
+
+        # completion must reach the hand Megatron specs for every block
+        # weight, INCLUDING through the flash custom_vjp (out_w via the
+        # fc activation, qkv_b via its matmul, norms replicated)
+        got = plan.arg_specs[0]
+        want = gpt.param_specs(cfg)
+
+        def norm(s):
+            t = tuple(s)
+            while t and t[-1] is None:
+                t = t[:-1]
+            return t
+        for key in ('qkv_w', 'fc_w', 'out_w', 'proj_w', 'qkv_b', 'fc_b',
+                    'ln1_g', 'ln2_g'):
+            assert norm(got['blocks'][key]) == norm(
+                want['blocks'][key]), (
+                key, got['blocks'][key], want['blocks'][key])
+        # Adam moments follow their parameters (zeros_like -> elementwise):
+        # the qkv_w moment must complete to the qkv_w param spec itself
+        mom_specs = plan.arg_specs[1]
+        flat_mom = dict(
+            (jax.tree_util.keystr(k), v) for k, v in
+            jax.tree_util.tree_flatten_with_path(mom_specs)[0])
+        mom_keys = [k for k in flat_mom
+                    if 'qkv_w' in k and 'moment1' in k]
+        assert mom_keys, sorted(flat_mom)[:5]
+        assert norm(flat_mom[mom_keys[0]]) == norm(
+            want['blocks']['qkv_w']), flat_mom[mom_keys[0]]
+
+        # planner-driven execution == hand-sharded execution
+        placed = plan.place((params, opt_state, toks), topo.mesh)
+        loss_p, newp_p, _ = plan.apply(step, topo.mesh)(*placed)
+
+        from jax.sharding import NamedSharding
+        hand = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(topo.mesh, s)),
+            params, want)
+        loss_h, newp_h, _ = jax.jit(step)(hand, opt.functional_init(hand),
+                                          jax.device_put(
+                                              toks, NamedSharding(
+                                                  topo.mesh,
+                                                  P('dp', None))))
+        assert np.isfinite(float(loss_p))
+        np.testing.assert_allclose(float(loss_p), float(loss_h), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(newp_p['blocks']['qkv_w']),
+            np.asarray(newp_h['blocks']['qkv_w']), atol=1e-5, rtol=1e-5)
+    finally:
+        fa.set_interpret(False)
+
+
+def test_flash_kernel_spec_passthrough():
+    """The pallas_call rules themselves: specs cross the kernel boundary
+    in both directions (without them, completion dies at the kernel)."""
+    import importlib
+    fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+    fa.set_interpret(True)
+    try:
+        def f(q, k, v):
+            return fa.flash_attention(q, k, v, causal=True)
+
+        q = jnp.zeros((4, 128, 2, 64), jnp.float32)
+        # forward: batch sharding on q flows to the output
+        plan = complete_shardings(
+            f, (q, q, q), (P('dp', None, None, None), None, None))
+        assert plan.out_specs[0][0] == 'dp'
+        # backward: output demand flows back into k/v via the kernel
+        qs, ks, vs = plan.arg_specs
+        assert ks[0] == 'dp' and vs[0] == 'dp'
+    finally:
+        fa.set_interpret(False)

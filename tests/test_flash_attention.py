@@ -726,3 +726,25 @@ def test_dropout_multiblock_and_padded_parity(s):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5, rtol=5e-4)
+
+
+def test_dropout_gqa_grad_parity():
+    """GQA + dropout BACKWARD: per-query-head masks applied before the
+    group-partial dk/dv sum must match the explicit-mask reference
+    (review r5c: forward-only GQA coverage left the dkv group reduction
+    unguarded)."""
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(7), 2, 256, 4, 64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(8), 2, 256, 2, 64)
+
+    def loss_flash(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True, dropout_rate=0.2,
+                                  dropout_seed=17).sum()
+
+    def loss_ref(q, k, v):
+        return _naive_dropout(q, k, v, True, 0.2, 17).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
