@@ -3,4 +3,5 @@ from . import gpt  # noqa: F401
 from . import ernie  # noqa: F401
 from . import moe_gpt  # noqa: F401
 from .crnn import CRNN  # noqa: F401
-from .ppyolo_lite import PPYOLOELite  # noqa: F401
+from .ppyolo_lite import PPYOLOE, PPYOLOELite  # noqa: F401
+from .svtr import SVTRLite  # noqa: F401

@@ -143,10 +143,10 @@ class Exporter:
         for cv, c in zip(jaxpr.constvars, consts):
             self.const_vals[cv] = np.asarray(c)
         for bi, oi in zip(jaxpr.invars, outer_in):
-            if oi in self.const_vals:
+            if isinstance(oi, Literal):       # Literal is unhashable: check
+                self.const_vals[bi] = np.asarray(oi.val)   # before dict use
+            elif oi in self.const_vals:
                 self.const_vals[bi] = self.const_vals[oi]
-            elif isinstance(oi, Literal):
-                self.const_vals[bi] = np.asarray(oi.val)
             else:
                 self.names[bi] = self.name_of(oi)
         self.run(jaxpr)
@@ -307,8 +307,79 @@ class Exporter:
                     [-1 if i == d else 1 for i in range(len(shape))]),
                 shape).astype(np.dtype(eqn.params['dtype']))
             self.const_vals[out] = arr
+        elif name == 'sort':
+            self._sort(eqn)
+        elif name == 'dynamic_slice':
+            self._dynamic_slice(eqn)
+        elif name == 'dynamic_update_slice':
+            self._dynamic_update_slice(eqn)
         else:
             self._inline(eqn)
+
+    # ---- sorting / dynamic indexing (r5: static-NMS detector export) ----
+
+    def _starts_tensor(self, start_vars):
+        """Scalar start operands -> one int64 [n] tensor (runtime values
+        allowed: each scalar is reshaped to [1], cast, concatenated)."""
+        parts = []
+        for sv in start_vars:
+            nm = self.emit('Reshape', [self.name_of(sv),
+                                       self.add_const(
+                                           np.asarray([1], np.int64))])
+            parts.append(self.emit('Cast', [nm], to=P.DTYPES[np.dtype(
+                np.int64)]))
+        if len(parts) == 1:
+            return parts[0]
+        return self.emit('Concat', parts, axis=0)
+
+    def _sort(self, eqn):
+        """lax.sort (ascending, 1 key) -> TopK(largest=0, K=dim size);
+        carried operands ride the permutation via GatherElements. Tie
+        order is runtime-defined (jax is stable) — detector NMS sorts
+        distinct scores, where this cannot matter."""
+        if eqn.params.get('num_keys', 1) != 1:
+            raise OnnxExportError('sort with num_keys > 1 not exported')
+        dim = eqn.params['dimension']
+        size = _shape(eqn.invars[0])[dim]
+        k = self.add_const(np.asarray([size], np.int64))
+        vals, idx = self.emit('TopK', [self.name_of(eqn.invars[0]), k],
+                              n_out=2, axis=dim, largest=0, sorted=1)
+        self.names[eqn.outvars[0]] = vals
+        for op_v, out_v in zip(eqn.invars[1:], eqn.outvars[1:]):
+            self.names[out_v] = self.emit(
+                'GatherElements', [self.name_of(op_v), idx], axis=dim)
+
+    def _dynamic_slice(self, eqn):
+        """lax.dynamic_slice with (possibly runtime) scalar starts ->
+        Slice with tensor starts/ends. jax's OOB-start clamping is NOT
+        reproduced — exported graphs must keep starts in range (the
+        static-NMS sweep does by construction)."""
+        operand, start_vars = eqn.invars[0], eqn.invars[1:]
+        sizes = np.asarray(eqn.params['slice_sizes'], np.int64)
+        starts = self._starts_tensor(start_vars)
+        ends = self.emit('Add', [starts, self.add_const(sizes)])
+        axes = self.add_const(np.arange(len(sizes), dtype=np.int64))
+        steps = self.add_const(np.ones(len(sizes), np.int64))
+        self.names[eqn.outvars[0]] = self.emit(
+            'Slice', [self.name_of(operand), starts, ends, axes, steps])
+
+    def _dynamic_update_slice(self, eqn):
+        """1-D lax.dynamic_update_slice -> ScatterND with runtime start
+        (indices = start + arange(len(update))). The NMS keep-array write
+        is the motivating case; higher ranks raise."""
+        operand, update = eqn.invars[0], eqn.invars[1]
+        if len(_shape(operand)) != 1:
+            raise OnnxExportError(
+                'dynamic_update_slice exported for 1-D operands only')
+        L = _shape(update)[0]
+        start = self._starts_tensor(eqn.invars[2:3])
+        idx = self.emit('Add', [
+            self.add_const(np.arange(L, dtype=np.int64)[:, None]),
+            self.emit('Reshape', [start, self.add_const(
+                np.asarray([1, 1], np.int64))])])
+        self.names[eqn.outvars[0]] = self.emit(
+            'ScatterND', [self.name_of(operand), idx,
+                          self.name_of(update)])
 
     def _dyn0_shape(self, shape):
         """Reshape target with the leading dim emitted as -1 (inferred).

@@ -136,8 +136,27 @@ def run_model(parsed_or_bytes, inputs):
             r = _pool(x[0], a, 'max')
         elif op == 'AveragePool':
             r = _pool(x[0], a, 'avg')
+        elif op == 'TopK':
+            axis = a.get('axis', -1)
+            k = int(np.asarray(x[1]).reshape(-1)[0])
+            key = -x[0] if a.get('largest', 1) else x[0]
+            idx = np.argsort(key, axis=axis, kind='stable')
+            idx = np.take(idx, np.arange(k), axis=axis)
+            vals = np.take_along_axis(x[0], idx, axis=axis)
+            r = [vals, idx.astype(np.int64)]
+        elif op == 'GatherElements':
+            r = np.take_along_axis(x[0], x[1].astype(np.int64),
+                                   axis=a.get('axis', 0))
+        elif op == 'ScatterND':
+            data, idx, upd = x
+            r = data.copy()
+            idx = idx.astype(np.int64)
+            for i in range(idx.shape[0]):
+                r[tuple(idx[i])] = upd[i]
         else:
             raise NotImplementedError(f'reference runtime: op {op}')
-        env[nd['outputs'][0]] = np.asarray(r)
+        outs = r if isinstance(r, list) else [r]
+        for o_name, val in zip(nd['outputs'], outs):
+            env[o_name] = np.asarray(val)
 
     return [env[o] for o in m['outputs']]

@@ -13,18 +13,14 @@ from ..core.tensor import Tensor
 
 
 def _iou_matrix(boxes):
-    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
-    xx1 = jnp.maximum(x1[:, None], x1[None, :])
-    yy1 = jnp.maximum(y1[:, None], y1[None, :])
-    xx2 = jnp.minimum(x2[:, None], x2[None, :])
-    yy2 = jnp.minimum(y2[:, None], y2[None, :])
-    inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
-    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+    """Self-IoU [n, n] — the pairwise kernel lives in vision/detection.py
+    (one IoU implementation for NMS, TAL assignment and GIoU)."""
+    from .detection import pairwise_iou
+    return pairwise_iou(boxes, boxes)
 
 
 def nms_static(boxes, scores, iou_threshold=0.3, max_out=None,
-               category_idxs=None):
+               category_idxs=None, unroll=False):
     """Fully traceable greedy NMS for jit'd detector graphs (the eager
     ``nms`` leaves the trace through a numpy boundary, so a served PP-YOLOE
     graph could not contain it — VERDICT r2 weak #7).
@@ -33,6 +29,10 @@ def nms_static(boxes, scores, iou_threshold=0.3, max_out=None,
     array (score-descending, padded with -1) and ``valid`` the kept count.
     XLA-friendly: one [n,n] IoU matrix + a fori_loop of vectorized
     suppression updates — no data-dependent shapes.
+
+    ``unroll=True`` traces the suppression sweep as n python iterations
+    instead of a fori_loop — identical numerics, a flat (loop-free) graph:
+    required for the ONNX exporter, which has no structured control flow.
     """
     b = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
     s = scores._value if isinstance(scores, Tensor) else jnp.asarray(scores)
@@ -58,8 +58,14 @@ def nms_static(boxes, scores, iou_threshold=0.3, max_out=None,
     # keep has one scratch slot at [max_out] so non-taken writes land there
     keep0 = jnp.full((max_out + 1,), -1, jnp.int32)
     supp0 = jnp.zeros((n,), bool)
-    keep, valid, _ = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n), body,
-                                       (keep0, jnp.int32(0), supp0))
+    carry = (keep0, jnp.int32(0), supp0)
+    if unroll:
+        for i in range(n):
+            carry = body(jnp.int32(i), carry)
+        keep, valid, _ = carry
+    else:
+        keep, valid, _ = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n),
+                                           body, carry)
     out = (Tensor(keep[:max_out]), Tensor(valid))
     return out
 

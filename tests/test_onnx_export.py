@@ -70,14 +70,32 @@ def test_unsupported_op_raises_clearly():
     import jax.numpy as jnp
     import paddle_tpu.nn as nn
 
+    class CumNet(nn.Layer):
+        def forward(self, x):
+            from paddle_tpu.core.dispatch import apply_op
+            import jax.lax
+            return apply_op(lambda v: jax.lax.cumsum(v, axis=1), x)
+
+    with pytest.raises(Exception) as ei:
+        _roundtrip(CumNet(), (2, 8))
+    assert 'cumsum' in str(ei.value).lower() or 'support' in str(ei.value)
+
+
+def test_sort_and_argsort_roundtrip():
+    """r5: lax.sort exports as TopK + GatherElements (the static-NMS
+    detector path); values AND carried argsort indices round-trip."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+
     class SortNet(nn.Layer):
         def forward(self, x):
             from paddle_tpu.core.dispatch import apply_op
-            return apply_op(lambda v: jnp.sort(v, axis=-1), x)
+            return apply_op(
+                lambda v: jnp.concatenate(
+                    [jnp.sort(v, axis=-1),
+                     jnp.argsort(v, axis=-1).astype(jnp.float32)], -1), x)
 
-    with pytest.raises(Exception) as ei:
-        _roundtrip(SortNet(), (2, 8))
-    assert 'sort' in str(ei.value).lower() or 'support' in str(ei.value)
+    _roundtrip(SortNet(), (2, 8))
 
 
 def test_wire_format_roundtrip():
