@@ -1,0 +1,73 @@
+"""Cost model: static + measured cost of a compiled program.
+
+Reference: python/paddle/cost_model/cost_model.py:1 (``CostModel`` with
+``static_cost_data()`` for per-op cost tables and ``profile_measure()``
+running a program under the profiler). TPU-native redesign: the program is
+a jittable function, and the STATIC costs come from XLA's own compiled
+cost analysis (flops / bytes accessed / peak memory — the numbers the
+reference approximates with hand-maintained op tables), while
+``profile_measure`` times real fenced executions.
+"""
+import time
+
+import jax
+
+__all__ = ['CostModel']
+
+
+class CostModel:
+    """Static and measured cost of a jittable function.
+
+    cm = CostModel()
+    data = cm.static_cost_data(fn, args)     # flops, bytes, peak memory
+    t = cm.profile_measure(fn, args)         # wall-time per execution
+    """
+
+    def _lowered(self, fn, args):
+        return jax.jit(fn).lower(*args)
+
+    def static_cost_data(self, fn, example_args):
+        """-> dict with 'flops', 'bytes_accessed', 'peak_memory_bytes'
+        (and every other key XLA's cost analysis reports), plus
+        'output_bytes'. Zero execution: the program is only compiled."""
+        compiled = self._lowered(fn, example_args).compile()
+        try:
+            cost = dict(compiled.cost_analysis() or {})
+        except Exception:
+            cost = {}
+        out = {'flops': float(cost.get('flops', 0.0)),
+               'bytes_accessed': float(cost.get('bytes accessed', 0.0))}
+        try:
+            mem = compiled.memory_analysis()
+            out['peak_memory_bytes'] = float(
+                getattr(mem, 'temp_size_in_bytes', 0)
+                + getattr(mem, 'output_size_in_bytes', 0)
+                + getattr(mem, 'argument_size_in_bytes', 0))
+            out['output_bytes'] = float(
+                getattr(mem, 'output_size_in_bytes', 0))
+        except Exception:
+            pass
+        out.update({k: float(v) for k, v in cost.items()
+                    if k not in ('flops', 'bytes accessed')})
+        return out
+
+    def profile_measure(self, fn, example_args, warmup=1, iters=5):
+        """Measured seconds per execution (median of ``iters`` fenced
+        runs; compile excluded by ``warmup``)."""
+        jfn = jax.jit(fn)
+
+        def run_once():
+            out = jfn(*example_args)
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready()
+                if hasattr(x, 'block_until_ready') else x, out)
+
+        for _ in range(max(1, warmup)):
+            run_once()
+        times = []
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            run_once()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]

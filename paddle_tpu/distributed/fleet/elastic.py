@@ -11,6 +11,9 @@ system. Liveness == fresh mtime; ordering == sorted node ids (deterministic
 rank assignment on every reconciliation).
 
     mgr = ElasticManager('/shared/job1', min_nodes=1, max_nodes=4)
+    # or any KVStore (elastic_store.py): the rendezvous medium is pluggable
+    # — FileStore (default, shared dir), MemoryStore (tests), or an
+    # etcd/Redis-backed store implementing the same 4 methods (r5 #10)
     mgr.register()
     members = mgr.wait_for_quorum()        # blocks until >= min_nodes
     ... run a training lifetime ...
@@ -21,16 +24,19 @@ on any scale event the local process group is stopped and relaunched with
 re-ranked PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, resuming from the latest
 checkpoint (same recovery path as crash/hang restarts).
 """
-import os
 import threading
 import time
 import uuid
+
+from .elastic_store import FileStore, KVStore
 
 
 class ElasticManager:
     def __init__(self, root, node_id=None, heartbeat_interval=1.0,
                  stale_after=None, min_nodes=1, max_nodes=None):
-        self.root = root
+        # ``root`` is a directory path (FileStore) or any KVStore instance
+        self.store = root if isinstance(root, KVStore) else None
+        self.root = None if isinstance(root, KVStore) else root
         self.node_id = node_id or f'{int(time.time() * 1e3):x}-{uuid.uuid4().hex[:6]}'
         self.interval = heartbeat_interval
         self.stale_after = stale_after or heartbeat_interval * 5
@@ -46,14 +52,15 @@ class ElasticManager:
         self._seen = {}                       # nid -> (content, t_observed)
 
     # ---- membership ----------------------------------------------------
-    def _path(self, nid):
-        return os.path.join(self.root, f'member_{nid}')
+    def _key(self, nid):
+        return f'member_{nid}'
 
-    def _done_path(self, nid):
-        return os.path.join(self.root, f'done_{nid}')
+    def _done_key(self, nid):
+        return f'done_{nid}'
 
     def register(self):
-        os.makedirs(self.root, exist_ok=True)
+        if self.store is None:
+            self.store = FileStore(self.root)
         self._touch()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
@@ -61,60 +68,41 @@ class ElasticManager:
 
     def _touch(self):
         self._seq += 1
-        tmp = self._path(self.node_id) + '.tmp'
-        with open(tmp, 'w') as f:
-            f.write(str(self._seq))
-        os.replace(tmp, self._path(self.node_id))
+        self.store.put(self._key(self.node_id), str(self._seq))
 
     def _beat(self):
         while not self._stop.wait(self.interval):
             try:
                 self._touch()
-            except OSError:
-                pass
+            except Exception:   # noqa: BLE001 — a transient store error
+                pass            # (etcd/Redis blip) must not kill the beat
 
     def mark_done(self):
         """Record CLEAN job completion: peers must not treat this node's
         departure as a failure/scale event (see poll)."""
         try:
-            with open(self._done_path(self.node_id), 'w') as f:
-                f.write('done')
-        except OSError:
+            self.store.put(self._done_key(self.node_id), 'done')
+        except Exception:       # noqa: BLE001 — see _beat
             pass
 
     def deregister(self):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2 * self.interval)
-        try:
-            os.remove(self._path(self.node_id))
-        except OSError:
-            pass
+        self.store.delete(self._key(self.node_id))
 
     def done_members(self):
-        try:
-            return {fn[len('done_'):] for fn in os.listdir(self.root)
-                    if fn.startswith('done_')}
-        except OSError:
-            return set()
+        return {k[len('done_'):] for k in self.store.keys('done_')}
 
     def live_members(self):
         """Sorted node ids with a progressing heartbeat (deterministic
         ranks)."""
         now = time.time()
         out = []
-        try:
-            names = os.listdir(self.root)
-        except OSError:
-            return out
-        for fn in names:
-            if not fn.startswith('member_') or fn.endswith('.tmp'):
-                continue
-            nid = fn[len('member_'):]
-            try:
-                with open(os.path.join(self.root, fn)) as f:
-                    content = f.read()
-            except OSError:
+        for key in self.store.keys('member_'):
+            nid = key[len('member_'):]
+            content = self.store.get(key)
+            if content is None:
                 continue                      # raced with a deregister
             prev = self._seen.get(nid)
             if prev is None or prev[0] != content:

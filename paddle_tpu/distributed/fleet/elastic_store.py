@@ -1,0 +1,100 @@
+"""Pluggable key-value stores for elastic membership.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:1 — the
+reference's manager is hard-wired to etcd (nodes register under a key
+prefix and watch it). TPU-native redesign keeps the MANAGER store-agnostic
+behind this four-method interface, so the rendezvous medium is deployment
+policy, not framework code:
+
+  - FileStore: a shared directory (local disk, NFS, GCS-fuse) — the
+    default; composes with the launcher's heartbeat machinery and needs
+    no extra service in the job.
+  - MemoryStore: in-process dict — unit tests and single-process dryruns.
+  - an etcd/Redis/TCP store is the same four methods over a client
+    (put/get are single-key linearizable ops; no watch API is required
+    because the manager POLLS — the interface stays trivially
+    implementable).
+
+Values are small strings (heartbeat sequence numbers, done markers).
+"""
+import os
+
+
+class KVStore:
+    """put/get/keys/delete over string keys and string values."""
+
+    def put(self, key, value):
+        raise NotImplementedError
+
+    def get(self, key):
+        """-> str or None if absent (absence is not an error)."""
+        raise NotImplementedError
+
+    def keys(self, prefix=''):
+        """-> list of keys starting with ``prefix``."""
+        raise NotImplementedError
+
+    def delete(self, key):
+        """Remove key; absent keys are a no-op."""
+        raise NotImplementedError
+
+
+class MemoryStore(KVStore):
+    def __init__(self):
+        self._d = {}
+
+    def put(self, key, value):
+        self._d[key] = str(value)
+
+    def get(self, key):
+        return self._d.get(key)
+
+    def keys(self, prefix=''):
+        return [k for k in self._d if k.startswith(prefix)]
+
+    def delete(self, key):
+        self._d.pop(key, None)
+
+
+class FileStore(KVStore):
+    """One file per key under ``root``; atomic replace on put. Keys map
+    1:1 to file names, so path separators and hidden-file prefixes are
+    rejected up front (a lossy escape would corrupt round-trips for keys
+    containing the escape text — review r5e)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        if '/' in key or '\\' in key or key.startswith('.') or not key:
+            raise ValueError(f'FileStore keys must be plain file names, '
+                             f'got {key!r}')
+        return os.path.join(self.root, key)
+
+    def put(self, key, value):
+        tmp = self._path(key) + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write(str(value))
+        os.replace(tmp, self._path(key))
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def keys(self, prefix=''):
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return [fn for fn in names
+                if fn.startswith(prefix) and not fn.endswith('.tmp')]
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass

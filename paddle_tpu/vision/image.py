@@ -16,8 +16,25 @@ def get_image_backend():
 
 
 def image_load(path, backend=None):
+    """Load an image as the active backend's native type: PIL Image
+    ('pil'/'tensor' default) or BGR ndarray ('cv2'), as the reference
+    image.py does."""
     if path.endswith('.npy'):
         return np.load(path)
+    backend = backend or _backend
+    if backend == 'cv2':
+        try:
+            import cv2
+        except ImportError as e:
+            raise ImportError('cv2 backend selected but OpenCV is not '
+                              'installed') from e
+        # 3-channel BGR like the reference (IMREAD_UNCHANGED would return
+        # 2-D grayscale / 4-channel BGRA that the cv2 kernels reject)
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise FileNotFoundError(
+                f'cv2 could not read image: {path!r}')
+        return img
     try:
         from PIL import Image
         return Image.open(path)

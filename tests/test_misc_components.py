@@ -614,3 +614,48 @@ def test_yolo_loss_jit_compiles_fast_with_many_boxes():
     dt = time.time() - t0
     assert np.isfinite(np.asarray(out)).all()
     assert dt < 30, f'compile+run took {dt:.1f}s'
+
+
+def test_cost_model_static_and_measured():
+    """paddle.cost_model (VERDICT r5 item 10): static costs come from
+    XLA's compiled cost analysis; profile_measure times fenced runs."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+
+    cm = paddle.cost_model.CostModel()
+
+    def fn(a, b):
+        return jnp.tanh(a @ b)
+
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 32), jnp.float32)
+    data = cm.static_cost_data(fn, (a, b))
+    # matmul flops = 2*M*N*K
+    assert data['flops'] >= 2 * 64 * 128 * 32
+    assert data['bytes_accessed'] > 0
+    t = cm.profile_measure(fn, (a, b), warmup=1, iters=3)
+    assert np.isfinite(t) and t > 0
+
+
+def test_elastic_memory_store_and_interface():
+    """Elastic membership over a pluggable KVStore: the MemoryStore path
+    (etcd-shaped API) behaves like the FileStore dir path."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.fleet.elastic_store import MemoryStore
+
+    store = MemoryStore()
+    a = ElasticManager(store, node_id='aa', heartbeat_interval=0.05,
+                       min_nodes=2)
+    b = ElasticManager(store, node_id='bb', heartbeat_interval=0.05,
+                       min_nodes=2)
+    a.register()
+    b.register()
+    members = a.wait_for_quorum(timeout=5)
+    assert members == ['aa', 'bb']
+    assert a.rank_of(members) == 0 and b.rank_of(members) == 1
+    # clean completion is not a scale event
+    b.mark_done()
+    b.deregister()
+    assert a.poll(members) is None
+    a.deregister()

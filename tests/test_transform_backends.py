@@ -1,0 +1,120 @@
+"""PIL / cv2 transform backends (VERDICT r5 item 9): PIL Images route to
+PIL kernels (and stay PIL), set_image_backend('cv2') routes ndarrays to
+OpenCV kernels, and the tensor path is untouched by default."""
+import numpy as np
+import pytest
+
+from PIL import Image
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import (get_image_backend, set_image_backend)
+from paddle_tpu.vision.transforms import functional as F
+
+
+@pytest.fixture(autouse=True)
+def _reset_backend():
+    yield
+    set_image_backend('tensor')
+
+
+def _pil(seed=0, size=(32, 24)):
+    rng = np.random.RandomState(seed)
+    return Image.fromarray(rng.randint(0, 255, size + (3,), dtype=np.uint8))
+
+
+def test_pil_inputs_stay_pil():
+    img = _pil()
+    out = F.resize(img, (16, 20))
+    assert isinstance(out, Image.Image) and out.size == (20, 16)
+    assert isinstance(F.hflip(img), Image.Image)
+    assert isinstance(F.crop(img, 2, 3, 10, 12), Image.Image)
+    assert F.crop(img, 2, 3, 10, 12).size == (12, 10)
+    assert isinstance(F.rotate(img, 30), Image.Image)
+    assert isinstance(F.adjust_brightness(img, 1.3), Image.Image)
+    assert isinstance(F.to_grayscale(img), Image.Image)
+
+
+def test_pil_nearest_resize_matches_tensor_nearest():
+    """Nearest-neighbour has one definition up to tie-breaking on exact
+    2x scaling — the backends must agree there."""
+    img = _pil(1, (8, 8))
+    got = np.asarray(F.resize(img, (16, 16), interpolation='nearest'))
+    want = F.resize(np.asarray(img), (16, 16), interpolation='nearest')
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_pil_bilinear_differs_from_tensor_bilinear():
+    """The documented semantics difference that motivated real backends:
+    PIL's bilinear kernel is not the jax one."""
+    img = _pil(2, (16, 16))
+    a = np.asarray(F.resize(img, (7, 7))).astype(np.float32)
+    b = np.asarray(F.resize(np.asarray(img).astype(np.float32),
+                            (7, 7))).astype(np.float32)
+    assert a.shape == b.shape
+    # close (both are bilinear) but NOT identical kernels
+    assert np.abs(a - b).max() > 0.5
+
+
+def test_pil_flip_and_enhance_pixel_semantics():
+    img = _pil(3)
+    np.testing.assert_array_equal(np.asarray(F.hflip(img)),
+                                  np.asarray(img)[:, ::-1])
+    np.testing.assert_array_equal(np.asarray(F.vflip(img)),
+                                  np.asarray(img)[::-1])
+    # brightness factor 0 -> black, 1 -> identity (PIL semantics)
+    np.testing.assert_array_equal(
+        np.asarray(F.adjust_brightness(img, 0.0)),
+        np.zeros_like(np.asarray(img)))
+    np.testing.assert_array_equal(
+        np.asarray(F.adjust_brightness(img, 1.0)), np.asarray(img))
+
+
+def test_pil_to_tensor_and_normalize():
+    img = _pil(4, (8, 6))
+    t = F.to_tensor(img)
+    assert tuple(t.shape) == (3, 8, 6)
+    arr = np.asarray(t._value)
+    assert arr.min() >= 0.0 and arr.max() <= 1.0
+    n = F.normalize(img, [0.5 * 255] * 3, [0.5 * 255] * 3)
+    assert n.shape == (3, 8, 6)
+    assert np.abs(n).max() <= 1.0 + 1e-6
+
+
+def test_cv2_backend_routes_ndarrays():
+    import cv2
+    set_image_backend('cv2')
+    assert get_image_backend() == 'cv2'
+    arr = np.random.RandomState(5).randint(0, 255, (16, 16, 3),
+                                           dtype=np.uint8)
+    got = F.resize(arr, (8, 8))
+    want = cv2.resize(arr, (8, 8), interpolation=cv2.INTER_LINEAR)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(F.hflip(arr), arr[:, ::-1])
+    g = F.to_grayscale(arr)
+    assert g.shape == (16, 16, 1)
+
+
+def test_tensor_backend_unchanged_by_default():
+    assert get_image_backend() == 'tensor'
+    arr = np.random.RandomState(6).rand(8, 8, 3).astype('f4')
+    out = F.resize(arr, (4, 4))
+    assert isinstance(out, np.ndarray)       # jax path, not cv2/PIL
+
+
+def test_compose_pipeline_with_pil_input():
+    from paddle_tpu.vision import transforms as T
+    tf = T.Compose([T.Resize((16, 16)), T.ToTensor(),
+                    T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = tf(_pil(7))
+    assert tuple(out.shape) == (3, 16, 16)
+
+
+def test_image_load_backends(tmp_path):
+    import os
+    p = os.path.join(tmp_path, 'x.png')
+    _pil(8, (10, 12)).save(p)
+    img = paddle.vision.image_load(p)
+    assert isinstance(img, Image.Image)
+    set_image_backend('cv2')
+    arr = paddle.vision.image_load(p)
+    assert isinstance(arr, np.ndarray) and arr.shape[:2] == (10, 12)
