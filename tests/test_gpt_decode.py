@@ -254,3 +254,56 @@ def test_top_p_degenerate_values():
         draws = {int(_sample(logits, 1.0, None, p, key=k)[0])
                  for k in jax.random.split(jax.random.PRNGKey(1), 10)}
         assert draws == {1}, (p, draws)   # argmax is index 1, NOT 0
+
+
+def test_gpt_config_dropout_is_sampled_in_training():
+    """GPTConfig.dropout actually drops attention weights during training
+    (r5: the field was previously accepted and ignored — the r4-journey
+    bug class), stays OFF for serving paths, and masks vary per step via
+    the step key while config.dropout=0 keeps the trace unchanged."""
+    import paddle_tpu as paddle
+
+    cfg = _cfg(dropout=0.5, num_heads=2, hidden_size=32)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size)
+
+    # train loss with two different keys differs (different masks)...
+    l1 = float(gpt.loss_fn(params, toks, toks, cfg,
+                           dropout_key=jax.random.PRNGKey(2)))
+    l2 = float(gpt.loss_fn(params, toks, toks, cfg,
+                           dropout_key=jax.random.PRNGKey(3)))
+    assert l1 != l2
+    # ...and differs from the no-dropout loss; same key reproduces
+    l0 = float(gpt.loss_fn(params, toks, toks, cfg))
+    assert l0 not in (l1, l2)
+    assert l1 == float(gpt.loss_fn(params, toks, toks, cfg,
+                                   dropout_key=jax.random.PRNGKey(2)))
+
+    # the full train step runs and decreases loss with dropout active
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+    step = gpt.make_train_step(cfg, opt)
+    state = opt.functional_init(params)
+    losses = []
+    p = params
+    for i in range(4):
+        loss, p, state = step(p, state, jax.random.PRNGKey(10 + i),
+                              jnp.asarray(1e-2), toks, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+    # sp/pp parallel layouts refuse dropout loudly (not silently ignored)
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        gpt.make_train_step(_cfg(dropout=0.1, num_heads=2, hidden_size=32,
+                                 pp=2, n_microbatches=2), opt)
+
+    # serving path is dropout-free: generate is deterministic greedy
+    model = gpt.GPTForCausalLM(cfg)
+    prompt = toks[:, :4]
+    o1 = np.asarray(model.generate(prompt, max_new_tokens=5,
+                                   temperature=0)._value)
+    o2 = np.asarray(model.generate(prompt, max_new_tokens=5,
+                                   temperature=0)._value)
+    np.testing.assert_array_equal(o1, o2)
