@@ -111,6 +111,40 @@ def main():
     dt = timeit(lambda: mm(a), iters=20)
     emit('gemm_tflops', 2 * 8192**3 / dt / 1e12)
 
+    # ---- 1.3B rung breakdown (r5: the north-star model class) ----------
+    # bf16 params + moments + full remat (the bench rung's memory story);
+    # failures here must not lose the 337M numbers above
+    try:
+        del params, opt_state, grads        # free HBM before the big model
+        big = gpt.GPTConfig(vocab_size=32768, hidden_size=2048,
+                            num_layers=24, num_heads=16, max_seq_len=SEQ,
+                            dtype='bfloat16', param_dtype='bfloat16',
+                            remat=True, use_flash=True,
+                            remat_policy='full')
+        bparams = gpt.init_params(big, key)
+        bn = sum(int(x.size) for x in jax.tree_util.tree_leaves(bparams))
+        bstate = opt.functional_init(bparams)
+
+        def bstep(p, s, l, t):
+            loss, grads = jax.value_and_grad(gpt.loss_fn)(p, t, t, big)
+            np_, ns = opt.functional_apply(p, grads, s, l)
+            return loss, np_, ns
+        jb = jax.jit(bstep)
+        dt = timeit(lambda: jb(bparams, bstate, lr, toks), iters=5)
+        emit('b13_full_ms', dt * 1e3)
+        emit('b13_tokens_per_sec', BATCH * SEQ / dt)
+        emit('b13_mfu', 6.0 * bn * res['b13_tokens_per_sec'] / 197e12)
+        jbh = jax.jit(lambda p, t: gpt.forward_hidden(p, t, big))
+        emit('b13_hidden_ms', timeit(lambda: jbh(bparams, toks),
+                                     iters=5) * 1e3)
+        jba = jax.jit(lambda p, g, s, l: opt.functional_apply(p, g, s, l))
+        _, bg = jax.jit(lambda p, t: jax.value_and_grad(gpt.loss_fn)(
+            p, t, t, big))(bparams, toks)
+        emit('b13_opt_ms', timeit(lambda: jba(bparams, bg, bstate, lr),
+                                  iters=5) * 1e3)
+    except Exception as e:                   # noqa: BLE001 — partial data
+        emit('b13_error', f'{type(e).__name__}: {e}'[:300])
+
     print(json.dumps(res))
 
 

@@ -31,11 +31,15 @@ def child(cfg):
     from paddle_tpu.models import gpt
 
     batch, seq = cfg['batch'], cfg['seq']
-    gcfg = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
+    gcfg = gpt.GPTConfig(vocab_size=32768,
+                         hidden_size=cfg.get('hidden', 1024),
+                         num_layers=cfg.get('layers', 24),
                          num_heads=16, max_seq_len=seq, dtype='bfloat16',
+                         param_dtype=cfg.get('param_dtype', 'float32'),
                          remat=cfg['remat'], use_flash=cfg['flash'],
                          remat_policy=cfg.get('policy', 'full'),
-                         scan_unroll=cfg.get('unroll', 1))
+                         scan_unroll=cfg.get('unroll', 1),
+                         xent_chunk=cfg.get('xent_chunk', 8192))
     params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
     opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
@@ -107,6 +111,24 @@ def main():
         variants = [
             dict(batch=8, seq=1024, flash=True, remat=True, bq=512, bk=512,
                  policy='dots', unroll=u) for u in (1, 2, 4)
+        ]
+    if '--r5' in sys.argv:
+        # the >=1B rung (VERDICT r5 #1): GPT-1.3B (hidden 2048, bf16
+        # params+moments). Levers: batch, remat policy, flash blocks,
+        # scan unroll, blockwise-vs-naive xent — bigger GEMMs than the
+        # 337M config, so the winning blocks may differ from r4's 512s.
+        b13 = dict(seq=1024, hidden=2048, flash=True, remat=True,
+                   param_dtype='bfloat16')
+        variants = [
+            dict(b13, batch=8, policy='full'),
+            dict(b13, batch=8, policy='dots'),
+            dict(b13, batch=16, policy='full'),
+            dict(b13, batch=4, policy='full'),
+            dict(b13, batch=8, policy='full', bq=512, bk=512),
+            dict(b13, batch=8, policy='full', bq=256, bk=256),
+            dict(b13, batch=8, policy='full', unroll=2),
+            dict(b13, batch=8, policy='full', xent_chunk=0),
+            dict(b13, batch=8, seq=2048, policy='full'),
         ]
     if quick:
         variants = variants[:3]

@@ -131,8 +131,8 @@ def main():
             # segment-level step-time breakdown + the scan-unroll tune rung
             for argv, out, bound in (
                     (['tools/tpu_breakdown.py'], 'TPU_BREAKDOWN.json', 2400),
-                    (['tools/tpu_tune.py', '--round3'], 'TPU_TUNE_R3.txt',
-                     3600)):
+                    (['tools/tpu_tune.py', '--r5'], 'TPU_TUNE_R5_1P3B.txt',
+                     5400)):
                 text, note, complete = None, '', False
                 try:
                     p = subprocess.run([sys.executable] + argv,
