@@ -289,16 +289,31 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
     prefill, step = _decode_fns_for(config)
     cache = init_kv_cache(config, B)
     logits, cache = prefill(params, jnp.asarray(prompt, jnp.int32), cache)
-    out = [jnp.asarray(prompt, jnp.int32)]
-    for i in range(n):
-        step_key = None
-        if key is not None:
-            key, step_key = jax.random.split(key)
-        nxt = _sample(logits, temperature, top_k, top_p, key=step_key)
-        out.append(nxt[:, None])
-        if i + 1 < n:
-            logits, cache = step(params, nxt, jnp.int32(T0 + i), cache)
-    return jnp.concatenate(out, axis=1)
+    if key is None:
+        from ..tensor.random import next_key
+        key = next_key()
+    key, first_key = jax.random.split(key)
+    first = _sample(logits, temperature, top_k, top_p, key=first_key)
+    pieces = [jnp.asarray(prompt, jnp.int32), first[:, None]]
+    if n > 1:
+        # remaining tokens run ON DEVICE in one dispatch (r5: the per-step
+        # python loop was tunnel-dispatch-bound — see gpt.make_generate_loop)
+        def body(carry, step_key):
+            tok, pos, cache = carry
+            logits, cache = forward_with_cache(params, tok[:, None], cache,
+                                               pos, config)
+            lg = logits[:, 0] if logits.ndim == 3 else logits
+            nxt = _sample(lg, temperature, top_k, top_p, key=step_key)
+            return (nxt, pos + 1, cache), nxt
+
+        @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+        def loop(tok0, pos0, cache, n_steps, key):
+            (tok, pos, cache), toks = jax.lax.scan(
+                body, (tok0, pos0, cache), jax.random.split(key, n_steps))
+            return jnp.swapaxes(toks, 0, 1)
+
+        pieces.append(loop(first, jnp.int32(T0), cache, n - 1, key))
+    return jnp.concatenate(pieces, axis=1)
 
 
 def make_train_step(config, optimizer, mesh=None):
