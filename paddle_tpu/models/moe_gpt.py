@@ -289,31 +289,56 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
     prefill, step = _decode_fns_for(config)
     cache = init_kv_cache(config, B)
     logits, cache = prefill(params, jnp.asarray(prompt, jnp.int32), cache)
-    if key is None:
+    if key is None and temperature != 0:
+        # greedy never consumes randomness: the global stream must not
+        # advance (seeded-script reproducibility — review r5g)
         from ..tensor.random import next_key
         key = next_key()
-    key, first_key = jax.random.split(key)
+    if key is not None:
+        key, first_key = jax.random.split(key)
+    else:
+        first_key = None
     first = _sample(logits, temperature, top_k, top_p, key=first_key)
     pieces = [jnp.asarray(prompt, jnp.int32), first[:, None]]
     if n > 1:
         # remaining tokens run ON DEVICE in one dispatch (r5: the per-step
         # python loop was tunnel-dispatch-bound — see gpt.make_generate_loop)
-        def body(carry, step_key):
-            tok, pos, cache = carry
-            logits, cache = forward_with_cache(params, tok[:, None], cache,
-                                               pos, config)
-            lg = logits[:, 0] if logits.ndim == 3 else logits
-            nxt = _sample(lg, temperature, top_k, top_p, key=step_key)
-            return (nxt, pos + 1, cache), nxt
-
-        @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-        def loop(tok0, pos0, cache, n_steps, key):
-            (tok, pos, cache), toks = jax.lax.scan(
-                body, (tok0, pos0, cache), jax.random.split(key, n_steps))
-            return jnp.swapaxes(toks, 0, 1)
-
-        pieces.append(loop(first, jnp.int32(T0), cache, n - 1, key))
+        loop = _generate_loop_for(config, temperature, top_k, top_p)
+        pieces.append(loop(params, first, jnp.int32(T0), cache, n - 1,
+                           key if key is not None
+                           else jax.random.PRNGKey(0)))
     return jnp.concatenate(pieces, axis=1)
+
+
+_GEN_LOOPS = {}
+
+
+def _generate_loop_for(config, temperature, top_k, top_p):
+    """Memoized on-device decode loop (a fresh jit wrapper per generate()
+    call would recompile the scanned program every time — review r5g)."""
+    import dataclasses
+    from .gpt import _sample
+    cache_key = (dataclasses.astuple(config), temperature, top_k, top_p)
+    if cache_key in _GEN_LOOPS:
+        return _GEN_LOOPS[cache_key]
+
+    def body_fn(params, carry, step_key):
+        tok, pos, cache = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cache,
+                                           pos, config)
+        lg = logits[:, 0] if logits.ndim == 3 else logits
+        nxt = _sample(lg, temperature, top_k, top_p, key=step_key)
+        return (nxt, pos + 1, cache), nxt
+
+    @partial(jax.jit, static_argnums=(4,), donate_argnums=(3,))
+    def loop(params, tok0, pos0, cache, n_steps, key):
+        (tok, pos, cache), toks = jax.lax.scan(
+            lambda c, k: body_fn(params, c, k), (tok0, pos0, cache),
+            jax.random.split(key, n_steps))
+        return jnp.swapaxes(toks, 0, 1)
+
+    _GEN_LOOPS[cache_key] = loop
+    return loop
 
 
 def make_train_step(config, optimizer, mesh=None):

@@ -349,18 +349,24 @@ def test_generate_loop_int8_weights_and_kv():
         tok = jnp.argmax(logits, -1).astype(jnp.int32)
         toks, _ = loop(p, tok, jnp.int32(8), cache,
                        jax.random.PRNGKey(2), 12)
-        return np.asarray(tok), np.asarray(toks)
+        return np.asarray(logits), np.asarray(toks)
 
-    t_bf, out_bf = run(cfg, params)
+    lg_bf, out_bf = run(cfg, params)
     qparams = jax.tree_util.tree_map(
         jnp.asarray, gpt.quantize_decode_params(params))
-    t_q, out_q = run(cfg, qparams)
+    lg_q, out_q = run(cfg, qparams)
     cfg8 = dataclasses.replace(cfg, kv_cache_int8=True)
-    t_qkv, out_qkv = run(cfg8, qparams)
+    lg_qkv, out_qkv = run(cfg8, qparams)
 
     for toks in (out_bf, out_q, out_qkv):
         assert toks.shape == (2, 12)
         assert (toks >= 0).all() and (toks < 128).all()
+    # int8 paths numerically track bf16 (argmax equality is not guaranteed
+    # under quantization, correlation of the prefill logits is): a broken
+    # dequant scale would destroy this
+    for lg in (lg_q, lg_qkv):
+        r = np.corrcoef(lg.ravel(), lg_bf.ravel())[0, 1]
+        assert r > 0.99, r
     # greedy loop == per-step python loop on the bf16 path (exactness)
     prefill, step = gpt.make_decode_fns(cfg)
     cache = gpt.init_kv_cache(cfg, 2)

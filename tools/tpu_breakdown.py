@@ -16,11 +16,20 @@ Run in a bounded subprocess:  timeout 900 python tools/tpu_breakdown.py
 """
 import json
 import os
+import signal
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# Clean self-exit BEFORE any outer bound can SIGKILL this chip-holding
+# process (the r3/r4 wedge mode: killing a client mid-execution wedges the
+# relay). Partial results were already emitted incrementally.
+signal.signal(signal.SIGALRM,
+              lambda *_: (_ for _ in ()).throw(
+                  SystemExit('breakdown: internal 2100s watchdog')))
+signal.alarm(int(os.environ.get('BREAKDOWN_TIMEOUT', '2100')))
 
 import jax
 import jax.numpy as jnp

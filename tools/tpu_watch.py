@@ -129,10 +129,14 @@ def main():
                             'recovery'], cwd=REPO)
             # post-bank diagnostics (logged, committed; failures tolerated):
             # segment-level step-time breakdown + the scan-unroll tune rung
+            # bounds sit ABOVE each tool's intrinsic/internal bound so the
+            # watcher's SIGKILL can only fire on a pathological hang:
+            # breakdown self-exits cleanly at 2100s (signal.alarm) and the
+            # tune's 9 variants are each subprocess-bounded at 1200s
             for argv, out, bound in (
                     (['tools/tpu_breakdown.py'], 'TPU_BREAKDOWN.json', 2400),
                     (['tools/tpu_tune.py', '--r5'], 'TPU_TUNE_R5_1P3B.txt',
-                     5400)):
+                     12000)):
                 text, note, complete = None, '', False
                 try:
                     p = subprocess.run([sys.executable] + argv,
