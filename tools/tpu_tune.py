@@ -129,6 +129,12 @@ def main():
             dict(b13, batch=8, policy='full', unroll=2),
             dict(b13, batch=8, policy='full', xent_chunk=0),
             dict(b13, batch=8, seq=2048, policy='full'),
+            # the 337M scan-unroll rungs queued since r4 (never ran on
+            # chip: the tunnel wedge ate that session's time)
+            dict(batch=8, seq=1024, flash=True, remat=True, policy='dots',
+                 bq=512, bk=512, unroll=2),
+            dict(batch=8, seq=1024, flash=True, remat=True, policy='dots',
+                 bq=512, bk=512, unroll=4),
         ]
     if quick:
         variants = variants[:3]
