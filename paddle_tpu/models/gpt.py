@@ -537,8 +537,8 @@ def _sample(logits, temperature, top_k, top_p=None, key=None):
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
-def make_generate_loop(config: GPTConfig, temperature=0.0, top_k=None,
-                       top_p=None):
+def make_generate_loop(config, temperature=0.0, top_k=None, top_p=None,
+                       forward_fn=None):
     """On-device autoregressive generation: ONE jitted program runs
     ``n_steps`` KV-cache decode steps via lax.scan (sampling included), so
     the whole loop costs a single dispatch instead of one host round-trip
@@ -550,14 +550,19 @@ def make_generate_loop(config: GPTConfig, temperature=0.0, top_k=None,
        returning (tokens [B, n_steps] i32, cache). ``tok0`` is consumed as
     the input of the first step; the sample drawn from each step's logits
     is both emitted and fed to the next step.
+
+    forward_fn(params, tokens, cache, pos, config) -> (logits, cache)
+    defaults to this module's forward_with_cache; moe_gpt passes its own,
+    sharing this one loop implementation.
     """
+    fwd = forward_fn or forward_with_cache
+
     def gen(params, tok0, pos0, cache, key, n_steps):
         def body(carry, step_key):
             tok, pos, cache = carry
-            logits, cache = forward_with_cache(params, tok[:, None], cache,
-                                               pos, config)
-            nxt = _sample(logits[:, 0], temperature, top_k, top_p,
-                          key=step_key)
+            logits, cache = fwd(params, tok[:, None], cache, pos, config)
+            lg = logits[:, 0] if logits.ndim == 3 else logits
+            nxt = _sample(lg, temperature, top_k, top_p, key=step_key)
             return (nxt, pos + 1, cache), nxt
 
         keys = jax.random.split(key, n_steps)

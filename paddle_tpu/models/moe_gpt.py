@@ -304,9 +304,10 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
         # remaining tokens run ON DEVICE in one dispatch (r5: the per-step
         # python loop was tunnel-dispatch-bound — see gpt.make_generate_loop)
         loop = _generate_loop_for(config, temperature, top_k, top_p)
-        pieces.append(loop(params, first, jnp.int32(T0), cache, n - 1,
-                           key if key is not None
-                           else jax.random.PRNGKey(0)))
+        new, _ = loop(params, first, jnp.int32(T0), cache,
+                      key if key is not None else jax.random.PRNGKey(0),
+                      n - 1)
+        pieces.append(new)
     return jnp.concatenate(pieces, axis=1)
 
 
@@ -314,31 +315,18 @@ _GEN_LOOPS = {}
 
 
 def _generate_loop_for(config, temperature, top_k, top_p):
-    """Memoized on-device decode loop (a fresh jit wrapper per generate()
-    call would recompile the scanned program every time — review r5g)."""
+    """Memoized on-device decode loop — gpt.make_generate_loop with THIS
+    module's cached forward (one loop implementation for both models; a
+    fresh jit wrapper per generate() call would recompile the scanned
+    program every time — review r5g)."""
     import dataclasses
-    from .gpt import _sample
+    from .gpt import make_generate_loop
     cache_key = (dataclasses.astuple(config), temperature, top_k, top_p)
-    if cache_key in _GEN_LOOPS:
-        return _GEN_LOOPS[cache_key]
-
-    def body_fn(params, carry, step_key):
-        tok, pos, cache = carry
-        logits, cache = forward_with_cache(params, tok[:, None], cache,
-                                           pos, config)
-        lg = logits[:, 0] if logits.ndim == 3 else logits
-        nxt = _sample(lg, temperature, top_k, top_p, key=step_key)
-        return (nxt, pos + 1, cache), nxt
-
-    @partial(jax.jit, static_argnums=(4,), donate_argnums=(3,))
-    def loop(params, tok0, pos0, cache, n_steps, key):
-        (tok, pos, cache), toks = jax.lax.scan(
-            lambda c, k: body_fn(params, c, k), (tok0, pos0, cache),
-            jax.random.split(key, n_steps))
-        return jnp.swapaxes(toks, 0, 1)
-
-    _GEN_LOOPS[cache_key] = loop
-    return loop
+    if cache_key not in _GEN_LOOPS:
+        _GEN_LOOPS[cache_key] = make_generate_loop(
+            config, temperature, top_k, top_p,
+            forward_fn=forward_with_cache)
+    return _GEN_LOOPS[cache_key]
 
 
 def make_train_step(config, optimizer, mesh=None):

@@ -47,6 +47,30 @@ class CSPBlock(nn.Layer):
         return self.cv3(concat([self.m(self.cv1(x)), self.cv2(x)], axis=1))
 
 
+class CSPBackbone(nn.Layer):
+    """Shared stem + c2..c5 CSP pyramid (strides 2..32) used by both the
+    anchor-free PPYOLOE and the legacy lite head."""
+
+    def __init__(self, w):
+        super().__init__()
+        self.stem = ConvBNAct(3, w, 3, 2)                               # /2
+        self.c2 = nn.Sequential(ConvBNAct(w, w * 2, 3, 2),
+                                CSPBlock(w * 2))                        # /4
+        self.c3 = nn.Sequential(ConvBNAct(w * 2, w * 4, 3, 2),
+                                CSPBlock(w * 4))                        # /8
+        self.c4 = nn.Sequential(ConvBNAct(w * 4, w * 8, 3, 2),
+                                CSPBlock(w * 8))                        # /16
+        self.c5 = nn.Sequential(ConvBNAct(w * 8, w * 16, 3, 2),
+                                CSPBlock(w * 16))                       # /32
+
+    def forward(self, x):
+        x = self.c2(self.stem(x))
+        c3 = self.c3(x)
+        c4 = self.c4(c3)
+        c5 = self.c5(c4)
+        return c3, c4, c5
+
+
 class ETHead(nn.Layer):
     """Decoupled per-level head: cls [B, C, H, W] + DFL reg
     [B, 4*(reg_max+1), H, W]."""
@@ -77,15 +101,7 @@ class PPYOLOE(nn.Layer):
         w = width
         self.num_classes = num_classes
         self.reg_max = reg_max
-        self.stem = ConvBNAct(3, w, 3, 2)                               # /2
-        self.c2 = nn.Sequential(ConvBNAct(w, w * 2, 3, 2),
-                                CSPBlock(w * 2))                        # /4
-        self.c3 = nn.Sequential(ConvBNAct(w * 2, w * 4, 3, 2),
-                                CSPBlock(w * 4))                        # /8
-        self.c4 = nn.Sequential(ConvBNAct(w * 4, w * 8, 3, 2),
-                                CSPBlock(w * 8))                        # /16
-        self.c5 = nn.Sequential(ConvBNAct(w * 8, w * 16, 3, 2),
-                                CSPBlock(w * 16))                       # /32
+        self.backbone = CSPBackbone(w)
         self.lat5 = ConvBNAct(w * 16, w * 8, 1)
         self.lat4 = ConvBNAct(w * 16, w * 4, 1)        # cat(up(p5), c4)
         self.lat3 = ConvBNAct(w * 8, w * 2, 1)         # cat(up(p4), c3)
@@ -94,11 +110,7 @@ class PPYOLOE(nn.Layer):
         self.head32 = ETHead(w * 8, num_classes, reg_max)
 
     def forward(self, x):
-        x = self.stem(x)
-        x = self.c2(x)
-        c3 = self.c3(x)
-        c4 = self.c4(c3)
-        c5 = self.c5(c4)
+        c3, c4, c5 = self.backbone(x)
         p5 = self.lat5(c5)
         p4 = self.lat4(concat([interpolate(p5, scale_factor=2,
                                            mode='nearest'), c4], axis=1))
@@ -213,11 +225,7 @@ class PPYOLOELite(nn.Layer):
         w = width
         self.num_classes = num_classes
         self.num_anchors = num_anchors
-        self.stem = ConvBNAct(3, w, 3, 2)                       # /2
-        self.c2 = nn.Sequential(ConvBNAct(w, w * 2, 3, 2), CSPBlock(w * 2))    # /4
-        self.c3 = nn.Sequential(ConvBNAct(w * 2, w * 4, 3, 2), CSPBlock(w * 4))  # /8
-        self.c4 = nn.Sequential(ConvBNAct(w * 4, w * 8, 3, 2), CSPBlock(w * 8))  # /16
-        self.c5 = nn.Sequential(ConvBNAct(w * 8, w * 16, 3, 2), CSPBlock(w * 16))  # /32
+        self.backbone = CSPBackbone(w)
         self.lat5 = ConvBNAct(w * 16, w * 8, 1)
         self.lat4 = ConvBNAct(w * 16, w * 4, 1)
         out_ch = num_anchors * (5 + num_classes)
@@ -225,11 +233,7 @@ class PPYOLOELite(nn.Layer):
         self.head16 = nn.Conv2D(w * 4, out_ch, 1)
 
     def forward(self, x):
-        x = self.stem(x)
-        x = self.c2(x)
-        c3 = self.c3(x)
-        c4 = self.c4(c3)
-        c5 = self.c5(c4)
+        c3, c4, c5 = self.backbone(x)
         p5 = self.lat5(c5)
         up = interpolate(p5, scale_factor=2, mode='nearest')
         p4 = self.lat4(concat([up, c4], axis=1))

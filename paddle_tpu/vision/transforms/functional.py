@@ -13,6 +13,20 @@ import numpy as np
 from ...core.tensor import Tensor
 
 
+def _backend_dispatch(fn):
+    """Route the first argument through the PIL/cv2 backends when they
+    claim it (see _route); otherwise run the tensor-path body below."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(img, *args, **kwargs):
+        r = _route(img, fn.__name__, *args, **kwargs)
+        if r is not None:
+            return r
+        return fn(img, *args, **kwargs)
+    return wrapper
+
+
 def _route(img, name, *args, **kwargs):
     """-> backend result, or None to continue on the tensor path."""
     try:
@@ -45,10 +59,8 @@ def _np(img):
     return np.asarray(img)
 
 
+@_backend_dispatch
 def to_tensor(pic, data_format='CHW'):
-    _r = _route(pic, 'to_tensor', data_format)
-    if _r is not None:
-        return _r
     arr = _np(pic).astype('float32')
     if arr.max() > 1.5:
         arr = arr / 255.0
@@ -57,10 +69,8 @@ def to_tensor(pic, data_format='CHW'):
     return Tensor(arr)
 
 
+@_backend_dispatch
 def resize(img, size, interpolation='bilinear'):
-    _r = _route(img, 'resize', size, interpolation)
-    if _r is not None:
-        return _r
     import jax
     import jax.numpy as jnp
     arr = _np(img)
@@ -78,17 +88,13 @@ def resize(img, size, interpolation='bilinear'):
     return np.asarray(jax.image.resize(jnp.asarray(arr), out_shape, method))
 
 
+@_backend_dispatch
 def crop(img, top, left, height, width):
-    _r = _route(img, 'crop', top, left, height, width)
-    if _r is not None:
-        return _r
     return _np(img)[top:top + height, left:left + width]
 
 
+@_backend_dispatch
 def center_crop(img, output_size):
-    _r = _route(img, 'center_crop', output_size)
-    if _r is not None:
-        return _r
     arr = _np(img)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
@@ -99,24 +105,18 @@ def center_crop(img, output_size):
     return crop(arr, i, j, th, tw)
 
 
+@_backend_dispatch
 def hflip(img):
-    _r = _route(img, 'hflip')
-    if _r is not None:
-        return _r
     return _np(img)[:, ::-1]
 
 
+@_backend_dispatch
 def vflip(img):
-    _r = _route(img, 'vflip')
-    if _r is not None:
-        return _r
     return _np(img)[::-1]
 
 
+@_backend_dispatch
 def pad(img, padding, fill=0, padding_mode='constant'):
-    _r = _route(img, 'pad', padding, fill=fill, padding_mode=padding_mode)
-    if _r is not None:
-        return _r
     arr = _np(img)
     if isinstance(padding, int):
         padding = (padding, padding, padding, padding)
@@ -131,11 +131,9 @@ def pad(img, padding, fill=0, padding_mode='constant'):
     return np.pad(arr, cfg, mode=mode)
 
 
+@_backend_dispatch
 def rotate(img, angle, interpolation='nearest', expand=False, center=None,
            fill=0):
-    _r = _route(img, 'rotate', angle, interpolation=interpolation, expand=expand, center=center, fill=fill)
-    if _r is not None:
-        return _r
     arr = _np(img)
     k = int(round(angle / 90.0)) % 4
     if abs(angle - 90 * round(angle / 90.0)) < 1e-6:
@@ -155,39 +153,31 @@ def rotate(img, angle, interpolation='nearest', expand=False, center=None,
     return out
 
 
+@_backend_dispatch
 def adjust_brightness(img, brightness_factor):
-    _r = _route(img, 'adjust_brightness', brightness_factor)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     hi = 255.0 if arr.max() > 1.5 else 1.0
     return np.clip(arr * brightness_factor, 0, hi).astype(_np(img).dtype)
 
 
+@_backend_dispatch
 def adjust_contrast(img, contrast_factor):
-    _r = _route(img, 'adjust_contrast', contrast_factor)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     hi = 255.0 if arr.max() > 1.5 else 1.0
     mean = arr.mean()
     return np.clip(mean + contrast_factor * (arr - mean), 0, hi).astype(_np(img).dtype)
 
 
+@_backend_dispatch
 def adjust_saturation(img, saturation_factor):
-    _r = _route(img, 'adjust_saturation', saturation_factor)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     hi = 255.0 if arr.max() > 1.5 else 1.0
     gray = arr.mean(axis=-1, keepdims=True)
     return np.clip(gray + saturation_factor * (arr - gray), 0, hi).astype(_np(img).dtype)
 
 
+@_backend_dispatch
 def adjust_hue(img, hue_factor):
-    _r = _route(img, 'adjust_hue', hue_factor)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     scale = 255.0 if arr.max() > 1.5 else 1.0
     x = arr / scale
@@ -216,10 +206,8 @@ def adjust_hue(img, hue_factor):
     return (out * scale).astype(_np(img).dtype)
 
 
+@_backend_dispatch
 def normalize(img, mean, std, data_format='CHW', to_rgb=False):
-    _r = _route(img, 'normalize', mean, std, data_format=data_format, to_rgb=to_rgb)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     mean = np.asarray(mean, 'float32')
     std = np.asarray(std, 'float32')
@@ -229,10 +217,8 @@ def normalize(img, mean, std, data_format='CHW', to_rgb=False):
     return (arr - mean) / std
 
 
+@_backend_dispatch
 def to_grayscale(img, num_output_channels=1):
-    _r = _route(img, 'to_grayscale', num_output_channels)
-    if _r is not None:
-        return _r
     arr = _np(img).astype('float32')
     gray = (0.2989 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2])
     gray = gray[..., None]
