@@ -190,9 +190,18 @@ def _attention(q, k, v, config, mesh=None, drop_seed=None):
     # getattr: MoEConfig shares this attention core but has no dropout field
     if getattr(config, 'dropout', 0.0) > 0.0 and drop_seed is not None:
         if config.sp > 1:
+            from ..parallel.ring_attention import (ring_flash_attention,
+                                                   ring_flash_available)
+            if config.use_flash and ring_flash_available(q, k):
+                # per-ring-pair masks regenerated in the backward sweep
+                return ring_flash_attention(q, k, v, axis_name='sp',
+                                            causal=True,
+                                            drop_rate=config.dropout,
+                                            seed=drop_seed)
             raise NotImplementedError(
-                'attention dropout under sequence parallelism (ring '
-                'attention) is not implemented — set dropout=0 or sp=1')
+                'attention dropout under sequence parallelism needs the '
+                'ring flash path (use_flash=True, 128-multiple local '
+                'shard) — or set dropout=0')
         if config.use_flash:
             from ..ops.flash_attention import flash_attention
             # falls back to the jnp path (same hash mask) on shapes or
@@ -309,12 +318,14 @@ def forward_hidden(params, tokens, config: GPTConfig, dropout_seed=None):
         body = _remat(body, config)
 
     if config.dropout > 0.0 and dropout_seed is not None:
-        # one derived seed per layer (odd multiplier decorrelates layers
-        # under the counter hash), riding the scan as an extra xs — the
-        # scan call and epilogue below are shared with the no-dropout path
-        seeds = (jnp.asarray(dropout_seed, jnp.uint32)
-                 + jnp.arange(config.num_layers, dtype=jnp.uint32)
-                 * jnp.uint32(0x9E3779B1))
+        # one derived seed per layer, riding the scan as an extra xs — the
+        # scan call and epilogue below are shared with the no-dropout
+        # path. mix_seed makes the fold nonlinear (review r5h: a linear
+        # stride can alias the hash's coordinate multipliers)
+        from ..ops.flash_attention import mix_seed
+        seeds = mix_seed(jnp.asarray(dropout_seed, jnp.uint32)
+                         + jnp.arange(config.num_layers, dtype=jnp.uint32)
+                         * jnp.uint32(0x27D4EB2F))
         xs = (params['blocks'], seeds)
 
         def scan_body(carry, inp):
@@ -610,13 +621,14 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
     specs = param_specs(config)
 
     use_shard_map = config.sp > 1 or config.pp > 1
-    if config.dropout > 0.0 and use_shard_map:
-        # the explicit-collective (sp/pp shard_map) loss paths do not
-        # sample dropout; silently training a different model than
-        # configured is the r4-journey bug class — refuse loudly
+    if config.dropout > 0.0 and config.pp > 1:
+        # the pipeline loss paths do not sample dropout; silently training
+        # a different model than configured is the r4-journey bug class —
+        # refuse loudly (sp rides the ring kernels' in-kernel masks; dp/mp
+        # ride the GSPMD path)
         raise NotImplementedError(
-            'attention dropout under sp/pp parallelism is not implemented '
-            '— set dropout=0, or use dp/mp-only layouts')
+            'attention dropout under pipeline parallelism is not '
+            'implemented — set dropout=0, or use dp/mp/sp layouts')
 
     if not use_shard_map:
         def step(params, opt_state, key, lr, tokens, targets):
@@ -639,7 +651,7 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
     if config.pp > 1 and config.pp_schedule == '1f1b':
         return _make_train_step_1f1b(config, optimizer, mesh, explicit_mp)
 
-    def spmd_loss(params, tokens, targets):
+    def spmd_loss(params, tokens, targets, seed=None):
         cdt = jnp.dtype(config.dtype)
         B, S = tokens.shape
         sp_idx = jax.lax.axis_index('sp') if config.sp > 1 else 0
@@ -651,8 +663,36 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
         if config.remat:
             body = _remat(body, config)
 
-        def scan_body(c, bp):
-            return body(bp, c), None
+        if config.dropout > 0.0 and seed is not None:
+            # decorrelate ranks whose kernels see identical LOCAL
+            # coordinates (dp batch shards; mp head shards), then one
+            # derived seed per layer (same scheme as forward_hidden); the
+            # sp ring folds its own (q rank, kv rank) pair into the seed.
+            # every fold is mix_seed'd — nonlinear, so index strides can
+            # never alias the hash's coordinate multipliers (review r5h)
+            from ..ops.flash_attention import mix_seed
+            seed_eff = mix_seed(
+                jnp.asarray(seed, jnp.uint32)
+                + jnp.asarray(jax.lax.axis_index('dp'), jnp.uint32)
+                * jnp.uint32(0x165667B1))
+            if explicit_mp:
+                seed_eff = mix_seed(
+                    seed_eff + jnp.asarray(jax.lax.axis_index('mp'),
+                                           jnp.uint32)
+                    * jnp.uint32(0xD3A2646D))
+            seeds = mix_seed(
+                seed_eff + jnp.arange(config.num_layers, dtype=jnp.uint32)
+                * jnp.uint32(0x27D4EB2F))
+            xs = (params['blocks'], seeds)
+
+            def scan_body(c, inp):
+                bp, sd = inp
+                return body(bp, c, drop_seed=sd), None
+        else:
+            xs = params['blocks']
+
+            def scan_body(c, bp):
+                return body(bp, c), None
 
         if config.pp > 1:
             def stage_fn(stage_params, xx):
@@ -661,7 +701,7 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
             x = pipeline_apply(stage_fn, params['blocks'], x,
                                config.n_microbatches, axis_name='pp')
         else:
-            x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+            x, _ = jax.lax.scan(scan_body, x, xs)
 
         x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
         logits = x @ params['wte'].T.astype(cdt)
@@ -675,13 +715,13 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
             loss = jnp.where(last_stage_mask('pp'), loss, 0.0)
         return loss
 
-    def spmd_valgrad(params, tokens, targets):
+    def spmd_valgrad(params, tokens, targets, seed=None):
         """value+grad INSIDE shard_map: the only collectives the vjp sees are
         ppermute (pipeline/ring — exact inverse-permutation transpose) and the
         custom-vjp Megatron f/g pair, so grads are exact per rank. Cross-rank
         reductions are applied explicitly afterwards."""
         loss, grads = jax.value_and_grad(
-            lambda p: spmd_loss(p, tokens, targets))(params)
+            lambda p: spmd_loss(p, tokens, targets, seed))(params)
         if config.pp > 1:
             # shared (non-block) params: embedding grads live on stage 0,
             # head grads on the last stage → assemble across stages
@@ -699,6 +739,21 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
 
     pspec_tree = train_specs(config)
     data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
+
+    if config.dropout > 0.0:
+        smapped = shard_map(spmd_valgrad, mesh=mesh,
+                            in_specs=(pspec_tree, data_spec, data_spec,
+                                      P()),
+                            out_specs=(P(), pspec_tree), check_rep=False)
+
+        def step(params, opt_state, key, lr, tokens, targets):
+            seed = jax.random.bits(key, (), jnp.uint32)
+            loss, grads = smapped(params, tokens, targets, seed)
+            new_p, new_s = optimizer.functional_apply(params, grads,
+                                                      opt_state, lr)
+            return loss, new_p, new_s
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     smapped = shard_map(spmd_valgrad, mesh=mesh,
                         in_specs=(pspec_tree, data_spec, data_spec),

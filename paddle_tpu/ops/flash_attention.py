@@ -197,6 +197,22 @@ def _dropout_keep(seed, row, q_pos, k_pos, rate):
         rate * (1 << 24))
 
 
+def mix_seed(x):
+    """Murmur-style finalizer over a u32 scalar/array. Every derived-seed
+    fold (per layer, per dp/mp rank, per ring pair) goes through this so
+    linear index arithmetic can NEVER align with the coordinate
+    multipliers inside ``_dropout_keep`` — a bare ``seed + idx * C`` fold
+    with C equal to a coordinate multiplier makes masks shifted copies of
+    each other instead of independent streams (review r5h)."""
+    x = jnp.asarray(x, jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
 def _drop_mult(shape, seed, row, qb, kb, bq, bk, rate):
     """[BQ, BK] f32 dropout multiplier tile: 1/(1-rate) kept, 0 dropped.
     Tile coordinates are converted to GLOBAL q/k positions so forward and

@@ -108,27 +108,50 @@ def _unbhsd(x, B, H):
     return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal):
+def _pair_seed(seed, idx, kv_rank, sp):
+    """Per-(q rank, kv source rank) dropout seed: both ranks fold in so no
+    two pairs share a mask stream (two q ranks visiting the same kv block
+    use the same LOCAL coordinates inside the kernels — without the idx
+    term their masks would be correlated). Matches between the forward and
+    backward ring sweeps because both track kv_rank identically. The fold
+    is mix_seed'd so the pair stride can never alias the mask hash's
+    coordinate multipliers (review r5h)."""
+    from ..ops.flash_attention import mix_seed
+    return mix_seed(jnp.asarray(seed, jnp.uint32)
+                    + (jnp.asarray(idx, jnp.uint32) * jnp.uint32(sp)
+                       + jnp.asarray(kv_rank, jnp.uint32))
+                    * jnp.uint32(0xB5297A4D))
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, drop_rate=0.0, seed=None):
     """-> (out [BH,S,D] in q.dtype, lse [BH,S] f32). Layout: kernel-major.
     GQA: k/v may carry H_kv = H/g heads — the ring rotates those smaller
-    blocks and the kernels serve each kv row to its query group."""
+    blocks and the kernels serve each kv row to its query group.
+
+    drop_rate/seed: in-kernel attention dropout per ring pair. Sound under
+    the lse merge: each hop's kernel normalizer accumulates UNdropped
+    probabilities, so the combined output is exactly
+    dropout(global softmax) @ v."""
     from ..ops.flash_attention import _flash_fwd
     sp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
     groups = H // k.shape[2]
     qr, kr, vr = _bhsd(q), _bhsd(k), _bhsd(v)
+    seed0 = jnp.asarray(0 if seed is None else seed, jnp.uint32)
 
-    def skip(_kv):
+    def skip(kv):
         return (jnp.zeros(qr.shape, jnp.float32),
                 jnp.full((B * H, S), -jnp.inf, jnp.float32))
 
     def off_diag(kv):
-        o, lse = _flash_fwd(qr, kv[0], kv[1], False, g=groups)
+        o, lse = _flash_fwd(qr, kv[0], kv[1], False, g=groups,
+                            drop_rate=drop_rate, seed=kv[2])
         return o.astype(jnp.float32), lse
 
     def diag(kv):
-        o, lse = _flash_fwd(qr, kv[0], kv[1], True, g=groups)
+        o, lse = _flash_fwd(qr, kv[0], kv[1], True, g=groups,
+                            drop_rate=drop_rate, seed=kv[2])
         return o.astype(jnp.float32), lse
 
     def body(carry, _):
@@ -140,8 +163,9 @@ def _ring_fwd_impl(q, k, v, axis_name, causal):
                                jnp.where(kv_rank == idx, 2, 1))
         else:
             branch = jnp.int32(1)
-        o_b, lse_b = jax.lax.switch(branch, [skip, off_diag, diag],
-                                    (k_cur, v_cur))
+        o_b, lse_b = jax.lax.switch(
+            branch, [skip, off_diag, diag],
+            (k_cur, v_cur, _pair_seed(seed0, idx, kv_rank, sp)))
         # log-sum-exp merge of two softmax-normalized partials
         lse_new = jnp.logaddexp(lse_acc, lse_b)
         w_a = jnp.exp(lse_acc - lse_new)[..., None]
@@ -162,31 +186,36 @@ def _ring_fwd_impl(q, k, v, axis_name, causal):
 from functools import partial as _partial
 
 
-@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def ring_flash_attention(q, k, v, axis_name='sp', causal=True):
-    """q/k/v: [B, S_local, H, D] inside shard_map over ``axis_name``."""
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q, k, v, axis_name='sp', causal=True,
+                         drop_rate=0.0, seed=None):
+    """q/k/v: [B, S_local, H, D] inside shard_map over ``axis_name``.
+    drop_rate (static) / seed (traced u32): in-kernel attention dropout —
+    the backward sweep regenerates the identical per-pair masks."""
     B, _, H, _ = q.shape
-    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, drop_rate, seed)
     return _unbhsd(out, B, H)
 
 
-def _rf_f(q, k, v, axis_name, causal):
+def _rf_f(q, k, v, axis_name, causal, drop_rate=0.0, seed=None):
     B, _, H, _ = q.shape
-    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
-    return _unbhsd(out, B, H), (q, k, v, out, lse)
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, drop_rate, seed)
+    return _unbhsd(out, B, H), (q, k, v, seed, out, lse)
 
 
-def _rf_b(axis_name, causal, res, g):
+def _rf_b(axis_name, causal, drop_rate, res, g):
     from ..ops.flash_attention import _bwd_pallas_pre, bwd_broadcasts
-    q, k, v, out, lse = res            # out [BH,S,D] dtype q, lse [BH,S] f32
+    q, k, v, seed, out, lse = res      # out [BH,S,D] dtype q, lse [BH,S] f32
     sp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
     groups = H // k.shape[2]
     qr, kr, vr, gr = _bhsd(q), _bhsd(k), _bhsd(v), _bhsd(g.astype(q.dtype))
     # global delta/lse lane-broadcasts depend only on (out, g): compute ONCE,
-    # reuse on every ring hop
+    # reuse on every ring hop. (delta = rowsum(g*out) remains the correct
+    # global term under dropout: sum_k D*dD == sum_k P*dP per column block.)
     lse_b, dta_b = bwd_broadcasts(out, lse, gr)
+    seed0 = jnp.asarray(0 if seed is None else seed, jnp.uint32)
 
     def skip(kv):
         z = jnp.zeros(qr.shape, jnp.float32)
@@ -198,7 +227,8 @@ def _rf_b(axis_name, causal, res, g):
         # delta, so each pair's tiled kernels emit exactly its
         # contribution to dq / dk / dv
         dq, dk, dv = _bwd_pallas_pre(qr, kv[0], kv[1], gr, lse_b, dta_b,
-                                     diag, groups=groups)
+                                     diag, groups=groups,
+                                     drop_rate=drop_rate, seed=kv[2])
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),
                 dv.astype(jnp.float32))
 
@@ -211,7 +241,8 @@ def _rf_b(axis_name, causal, res, g):
             branch = jnp.int32(1)
         dq_b, dk_b, dv_b = jax.lax.switch(
             branch, [skip, _partial(pair, diag=False),
-                     _partial(pair, diag=True)], (k_cur, v_cur))
+                     _partial(pair, diag=True)],
+            (k_cur, v_cur, _pair_seed(seed0, idx, kv_rank, sp)))
         dq_acc = dq_acc + dq_b
         dk_cur = dk_cur + dk_b
         dv_cur = dv_cur + dv_b
@@ -230,9 +261,14 @@ def _rf_b(axis_name, causal, res, g):
     (dq, _, _, dk, dv, _), _ = jax.lax.scan(
         body, (z, kr, vr, zkv, zkv, idx), None, length=sp)
     h_kv = k.shape[2]
+    dseed = None
+    if seed is not None:
+        import numpy as _np
+        dseed = _np.zeros(jnp.shape(seed), jax.dtypes.float0)
     return (_unbhsd(dq.astype(q.dtype), B, H),
             _unbhsd(dk.astype(k.dtype), B, h_kv),
-            _unbhsd(dv.astype(v.dtype), B, h_kv))
+            _unbhsd(dv.astype(v.dtype), B, h_kv),
+            dseed)
 
 
 ring_flash_attention.defvjp(_rf_f, _rf_b)
