@@ -78,7 +78,10 @@ def main():
         toks = jnp.asarray(rs.randint(0, args.vocab,
                                       (args.batch, args.seq)), jnp.int32)
         t0 = time.perf_counter()
-        loss, params, opt_state = step(params, opt_state, key,
+        # per-step key: dropout (when configured) must draw a fresh mask
+        # each step, not train a fixed pruned subnetwork
+        loss, params, opt_state = step(params, opt_state,
+                                       jax.random.fold_in(key, i),
                                        jnp.asarray(args.lr), toks, toks)
         loss = float(loss)
         dt = time.perf_counter() - t0

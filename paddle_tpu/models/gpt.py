@@ -187,7 +187,9 @@ def _attention(q, k, v, config, mesh=None, drop_seed=None):
     IN-KERNEL on the flash path (ops/flash_attention counter-hash; the
     jnp fallback applies the identical mask), so attention dropout never
     forces the XLA path (VERDICT r4 weak #8)."""
-    # getattr: MoEConfig shares this attention core but has no dropout field
+    # getattr: other configs sharing this attention core may predate the
+    # dropout field (MoEConfig has it since r5; defensive for any future
+    # config class)
     if getattr(config, 'dropout', 0.0) > 0.0 and drop_seed is not None:
         if config.sp > 1:
             from ..parallel.ring_attention import (ring_flash_attention,
@@ -319,13 +321,9 @@ def forward_hidden(params, tokens, config: GPTConfig, dropout_seed=None):
 
     if config.dropout > 0.0 and dropout_seed is not None:
         # one derived seed per layer, riding the scan as an extra xs — the
-        # scan call and epilogue below are shared with the no-dropout
-        # path. mix_seed makes the fold nonlinear (review r5h: a linear
-        # stride can alias the hash's coordinate multipliers)
-        from ..ops.flash_attention import mix_seed
-        seeds = mix_seed(jnp.asarray(dropout_seed, jnp.uint32)
-                         + jnp.arange(config.num_layers, dtype=jnp.uint32)
-                         * jnp.uint32(0x27D4EB2F))
+        # scan call and epilogue below are shared with the no-dropout path
+        from ..ops.flash_attention import per_layer_seeds
+        seeds = per_layer_seeds(dropout_seed, config.num_layers)
         xs = (params['blocks'], seeds)
 
         def scan_body(carry, inp):
@@ -670,7 +668,7 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
             # sp ring folds its own (q rank, kv rank) pair into the seed.
             # every fold is mix_seed'd — nonlinear, so index strides can
             # never alias the hash's coordinate multipliers (review r5h)
-            from ..ops.flash_attention import mix_seed
+            from ..ops.flash_attention import mix_seed, per_layer_seeds
             seed_eff = mix_seed(
                 jnp.asarray(seed, jnp.uint32)
                 + jnp.asarray(jax.lax.axis_index('dp'), jnp.uint32)
@@ -680,9 +678,7 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
                     seed_eff + jnp.asarray(jax.lax.axis_index('mp'),
                                            jnp.uint32)
                     * jnp.uint32(0xD3A2646D))
-            seeds = mix_seed(
-                seed_eff + jnp.arange(config.num_layers, dtype=jnp.uint32)
-                * jnp.uint32(0x27D4EB2F))
+            seeds = per_layer_seeds(seed_eff, config.num_layers)
             xs = (params['blocks'], seeds)
 
             def scan_body(c, inp):

@@ -39,6 +39,9 @@ class MoEConfig:
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
     max_seq_len: int = 1024
+    # attention dropout (train-time; sampled IN-KERNEL via gpt._attention
+    # when the step provides a key — see gpt.GPTConfig.dropout)
+    dropout: float = 0.0
     dtype: str = 'bfloat16'
     param_dtype: str = 'float32'
     remat: bool = True
@@ -107,14 +110,14 @@ def param_specs(config: MoEConfig):
             'lnf_g': P(), 'lnf_b': P()}
 
 
-def block_fn(bp, carry, config):
+def block_fn(bp, carry, config, drop_seed=None):
     x, aux_acc = carry
     cdt = jnp.dtype(config.dtype)
     B, S, h = x.shape
     nh, hd = config.num_heads, config.head_dim
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
-    a = _attention(q, k, v, config).reshape(B, S, h)
+    a = _attention(q, k, v, config, drop_seed=drop_seed).reshape(B, S, h)
     x = x + wo_matmul(a, bp['proj_w'], cdt) + bp['proj_b'].astype(cdt)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, aux = moe_ffn(y, bp['gate_w'].astype(cdt),
@@ -123,8 +126,9 @@ def block_fn(bp, carry, config):
     return (x + ff, aux_acc + aux), None
 
 
-def forward_hidden(params, tokens, config):
-    """-> (final hidden [B,S,H], aux load-balance loss)."""
+def forward_hidden(params, tokens, config, dropout_seed=None):
+    """-> (final hidden [B,S,H], aux load-balance loss). dropout_seed: see
+    gpt.forward_hidden (per-layer mixed seeds; None = unchanged trace)."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     x = (wo_take(params['wte'], tokens) +
@@ -132,30 +136,45 @@ def forward_hidden(params, tokens, config):
     body = partial(block_fn, config=config)
     if config.remat:
         body = jax.checkpoint(body)
-    (x, aux), _ = jax.lax.scan(lambda c, bp: body(bp, c), (x, jnp.zeros((), jnp.float32)),
-                               params['blocks'])
+    carry0 = (x, jnp.zeros((), jnp.float32))
+    if config.dropout > 0.0 and dropout_seed is not None:
+        from ..ops.flash_attention import per_layer_seeds
+        xs = (params['blocks'],
+              per_layer_seeds(dropout_seed, config.num_layers))
+
+        def scan_body(c, inp):
+            return body(inp[0], c, drop_seed=inp[1])
+    else:
+        xs = params['blocks']
+
+        def scan_body(c, bp):
+            return body(bp, c)
+
+    (x, aux), _ = jax.lax.scan(scan_body, carry0, xs)
     return _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt), aux
 
 
-def forward(params, tokens, config):
-    x, aux = forward_hidden(params, tokens, config)
+def forward(params, tokens, config, dropout_seed=None):
+    x, aux = forward_hidden(params, tokens, config, dropout_seed)
     return wo_lm_head(x, params['wte'], x.dtype), aux
 
 
-def loss_fn(params, tokens, targets, config):
+def loss_fn(params, tokens, targets, config, dropout_key=None):
+    seed = (jax.random.bits(dropout_key, (1,), jnp.uint32)[0]
+            if config.dropout > 0.0 and dropout_key is not None else None)
     aux_scale = config.aux_weight / config.num_layers
     if (config.xent_chunk and config.mp == 1 and config.sp == 1
             and config.pp == 1
             and config.vocab_size % config.xent_chunk == 0):
         # blockwise LM-head loss (ops/xent.py): no [B,S,V] logits in HBM
         from ..ops.xent import softmax_xent_blockwise
-        x, aux = forward_hidden(params, tokens, config)
+        x, aux = forward_hidden(params, tokens, config, seed)
         B, S, H = x.shape
         ce = softmax_xent_blockwise(x.reshape(B * S, H), params['wte'],
                                     targets.reshape(B * S),
                                     config.xent_chunk)
         return ce + aux_scale * aux
-    logits, aux = forward(params, tokens, config)
+    logits, aux = forward(params, tokens, config, seed)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + aux_scale * aux
@@ -334,7 +353,11 @@ def make_train_step(config, optimizer, mesh=None):
     mesh = mesh or get_mesh()
 
     def step(params, opt_state, key, lr, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, config)
+        # the step key drives attention dropout when configured
+        # (config.dropout == 0 leaves the trace unchanged — see gpt)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, config,
+            key if config.dropout > 0.0 else None)
         new_p, new_s = optimizer.functional_apply(params, grads, opt_state, lr)
         return loss, new_p, new_s
     return jax.jit(step, donate_argnums=(0, 1))

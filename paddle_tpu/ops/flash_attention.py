@@ -213,6 +213,15 @@ def mix_seed(x):
     return x
 
 
+def per_layer_seeds(seed, n_layers):
+    """One mixed dropout seed per transformer layer — THE canonical
+    per-layer fold (all models share it so the aliasing-sensitive stride
+    constant lives in exactly one place; see mix_seed)."""
+    return mix_seed(jnp.asarray(seed, jnp.uint32)
+                    + jnp.arange(n_layers, dtype=jnp.uint32)
+                    * jnp.uint32(0x27D4EB2F))
+
+
 def _drop_mult(shape, seed, row, qb, kb, bq, bk, rate):
     """[BQ, BK] f32 dropout multiplier tile: 1/(1-rate) kept, 0 dropped.
     Tile coordinates are converted to GLOBAL q/k positions so forward and
