@@ -28,6 +28,12 @@ class ErnieConfig:
     dtype: str = 'bfloat16'
     param_dtype: str = 'float32'
     remat: bool = True
+    # pallas flash attention (bidirectional, additive key-padding mask
+    # in-kernel); falls back to the XLA path off-TPU automatically
+    use_flash: bool = True
+    # attention dropout (train-time; in-kernel counter-hash masks — the
+    # pretrain loss derives per-layer seeds from its dropout_key)
+    dropout: float = 0.0
 
     @property
     def head_dim(self):
@@ -75,7 +81,7 @@ def _ln(x, g, b, eps=1e-12):
     return (x - m) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _block(bp, x, mask_bias, config):
+def _block(bp, x, mask_bias, config, drop_seed=None):
     cdt = jnp.dtype(config.dtype)
     B, S, h = x.shape
     nh, hd = config.num_heads, config.head_dim
@@ -84,10 +90,20 @@ def _block(bp, x, mask_bias, config):
     q = q.reshape(B, S, nh, hd)
     k = k.reshape(B, S, nh, hd)
     v = v.reshape(B, S, nh, hd)
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) / math.sqrt(hd)
-    s = s + mask_bias
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cdt)
-    a = jnp.einsum('bhqk,bkhd->bqhd', p, v).reshape(B, S, h)
+    # bidirectional attention through the flash kernels (r5): the additive
+    # [B,1,1,S] key-padding bias rides in-kernel (None = the kernels' mask
+    # fast path); shapes/platforms the kernels decline fall back to the
+    # identical-math XLA path, which samples the identical dropout mask
+    from ..ops.flash_attention import _jnp_attention, flash_attention
+    drop = config.dropout if drop_seed is not None else 0.0
+    mask = None if mask_bias is None else mask_bias.astype(jnp.float32)
+    if config.use_flash:
+        a = flash_attention(q, k, v, causal=False, mask=mask,
+                            dropout_rate=drop, dropout_seed=drop_seed)
+    else:
+        a = _jnp_attention(q, k, v, False, mask, drop_rate=drop,
+                           seed=drop_seed)
+    a = a.astype(cdt).reshape(B, S, h)
     x = _ln(x + a @ bp['proj_w'].astype(cdt) + bp['proj_b'].astype(cdt),
             bp['ln1_g'], bp['ln1_b']).astype(cdt)
     y = jax.nn.gelu(x @ bp['fc_w'].astype(cdt) + bp['fc_b'].astype(cdt))
@@ -95,7 +111,8 @@ def _block(bp, x, mask_bias, config):
     return _ln(x + y, bp['ln2_g'], bp['ln2_b']).astype(cdt)
 
 
-def encode(params, tokens, token_type=None, attn_mask=None, config=None):
+def encode(params, tokens, token_type=None, attn_mask=None, config=None,
+           dropout_seed=None):
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     tt = token_type if token_type is not None else jnp.zeros_like(tokens)
@@ -106,7 +123,7 @@ def encode(params, tokens, token_type=None, attn_mask=None, config=None):
     if attn_mask is not None:
         bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e30).astype(cdt)
     else:
-        bias = jnp.zeros((B, 1, 1, S), cdt)
+        bias = None          # unmasked: keep the kernels' no-mask fast path
 
     body = partial(_block, mask_bias=bias, config=config)
     if config.remat:
@@ -115,17 +132,30 @@ def encode(params, tokens, token_type=None, attn_mask=None, config=None):
         # assignment rebinds to the checkpointed lambda itself
         body = jax.checkpoint(body)
 
-    def scan_body(c, bp):
-        return body(bp, c), None
-    x, _ = jax.lax.scan(scan_body, x, params['blocks'])
+    if config.dropout > 0.0 and dropout_seed is not None:
+        from ..ops.flash_attention import per_layer_seeds
+        xs = (params['blocks'],
+              per_layer_seeds(dropout_seed, config.num_layers))
+
+        def scan_body(c, inp):
+            return body(inp[0], c, drop_seed=inp[1]), None
+    else:
+        xs = params['blocks']
+
+        def scan_body(c, bp):
+            return body(bp, c), None
+    x, _ = jax.lax.scan(scan_body, x, xs)
     return x
 
 
 def pretrain_loss(params, tokens, token_type, attn_mask, mlm_labels,
-                  nsp_labels, config):
+                  nsp_labels, config, dropout_key=None):
     """Masked-LM + next-sentence losses (BERT pretraining objective).
-    mlm_labels: -100 where not predicted."""
-    h = encode(params, tokens, token_type, attn_mask, config)
+    mlm_labels: -100 where not predicted. dropout_key: enables
+    config.dropout attention dropout for this step."""
+    seed = (jax.random.bits(dropout_key, (1,), jnp.uint32)[0]
+            if config.dropout > 0.0 and dropout_key is not None else None)
+    h = encode(params, tokens, token_type, attn_mask, config, seed)
     cdt = h.dtype
     # MLM head
     mh = jax.nn.gelu(h @ params['mlm_w'].astype(cdt) + params['mlm_b'].astype(cdt))
