@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
+from ..fault.inject import inject
 
 
 class ReduceOp:
@@ -50,6 +51,7 @@ def _in_trace(x):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True):
+    inject('collective.entry')
     axis = _cur_axis(group)
 
     def pure(v):
@@ -74,6 +76,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True):
 
 
 def all_gather(tensor_list, tensor, group=None, use_calc_stream=True, axis=0):
+    inject('collective.entry')
     ax = _cur_axis(group)
 
     def pure(v):
@@ -90,6 +93,7 @@ def all_gather(tensor_list, tensor, group=None, use_calc_stream=True, axis=0):
 
 
 def broadcast(tensor, src=0, group=None, use_calc_stream=True):
+    inject('collective.entry')
     ax = _cur_axis(group)
 
     def pure(v):
@@ -127,6 +131,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, use_calc_stream=True):
 
 
 def reduce_scatter(output, input_list, op=ReduceOp.SUM, group=None):
+    inject('collective.entry')
     ax = _cur_axis(group)
     stacked = jnp.concatenate([t._value if isinstance(t, Tensor) else jnp.asarray(t)
                                for t in input_list])
@@ -143,6 +148,7 @@ def reduce_scatter(output, input_list, op=ReduceOp.SUM, group=None):
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, use_calc_stream=True):
+    inject('collective.entry')
     ax = _cur_axis(group)
     xs = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
           for t in in_tensor_list]
@@ -171,6 +177,7 @@ def recv(tensor, src=0, group=None, use_calc_stream=True):
 
 
 def barrier(group=None):
+    inject('collective.entry')
     for d in jax.devices():
         pass
     jax.effects_barrier() if hasattr(jax, 'effects_barrier') else None

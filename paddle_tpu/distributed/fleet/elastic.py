@@ -27,13 +27,16 @@ checkpoint (same recovery path as crash/hang restarts).
 import threading
 import time
 import uuid
+import warnings
 
+from ...fault.inject import inject
 from .elastic_store import FileStore, KVStore
 
 
 class ElasticManager:
     def __init__(self, root, node_id=None, heartbeat_interval=1.0,
-                 stale_after=None, min_nodes=1, max_nodes=None):
+                 stale_after=None, min_nodes=1, max_nodes=None,
+                 heartbeat_fail_limit=5):
         # ``root`` is a directory path (FileStore) or any KVStore instance
         self.store = root if isinstance(root, KVStore) else None
         self.root = None if isinstance(root, KVStore) else root
@@ -45,6 +48,13 @@ class ElasticManager:
         self._stop = threading.Event()
         self._thread = None
         self._seq = 0
+        # heartbeat outage surfacing: consecutive store failures are counted
+        # (not silently swallowed); after heartbeat_fail_limit the manager
+        # warns ONCE and raises ``degraded`` until the store recovers
+        self.heartbeat_fail_limit = max(1, heartbeat_fail_limit)
+        self.hb_consecutive_failures = 0
+        self.degraded = False
+        self._hb_warned = False
         # liveness is judged by heartbeat CONTENT progress against THIS
         # manager's own clock (seq unchanged for stale_after => stale):
         # immune to writer/reader clock skew and NFS mtime quirks that a
@@ -70,12 +80,34 @@ class ElasticManager:
         self._seq += 1
         self.store.put(self._key(self.node_id), str(self._seq))
 
+    def _hb_ok(self):
+        self.hb_consecutive_failures = 0
+        self.degraded = False
+        self._hb_warned = False       # a future outage warns again
+
+    def _hb_fail(self, exc):
+        """A store error must not kill the beat — but it must not be
+        invisible either: count it, surface ``degraded``, warn once."""
+        self.hb_consecutive_failures += 1
+        if self.hb_consecutive_failures >= self.heartbeat_fail_limit:
+            self.degraded = True
+            if not self._hb_warned:
+                self._hb_warned = True
+                warnings.warn(
+                    f'elastic heartbeat: {self.hb_consecutive_failures} '
+                    f'consecutive store failures (last: {exc!r}) — node '
+                    f'{self.node_id} may be declared stale by peers',
+                    RuntimeWarning, stacklevel=2)
+
     def _beat(self):
         while not self._stop.wait(self.interval):
             try:
+                inject('store.heartbeat')
                 self._touch()
-            except Exception:   # noqa: BLE001 — a transient store error
-                pass            # (etcd/Redis blip) must not kill the beat
+            except Exception as e:   # noqa: BLE001 — a transient store error
+                self._hb_fail(e)     # (etcd/Redis blip) must not kill the beat
+            else:
+                self._hb_ok()
 
     def mark_done(self):
         """Record CLEAN job completion: peers must not treat this node's
@@ -90,6 +122,30 @@ class ElasticManager:
         if self._thread is not None:
             self._thread.join(timeout=2 * self.interval)
         self.store.delete(self._key(self.node_id))
+        self.store.delete(self._ckpt_key(self.node_id))
+
+    # ---- checkpoint agreement ------------------------------------------
+    def _ckpt_key(self, nid):
+        return f'ckptstep_{nid}'
+
+    def advertise_step(self, step):
+        """Publish this node's latest VERIFIED checkpoint step so the next
+        lifetime's re-ranked workers can agree on a restore point."""
+        self.store.put(self._ckpt_key(self.node_id), str(int(step)))
+
+    def agreed_step(self):
+        """Greatest checkpoint step every live member has (min over
+        advertisements) — the newest state the whole job can restore from.
+        None when nobody advertised yet."""
+        steps = []
+        for nid in self.live_members():
+            v = self.store.get(self._ckpt_key(nid))
+            if v is not None:
+                try:
+                    steps.append(int(v))
+                except ValueError:
+                    continue
+        return min(steps) if steps else None
 
     def done_members(self):
         return {k[len('done_'):] for k in self.store.keys('done_')}

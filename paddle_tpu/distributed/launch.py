@@ -64,6 +64,12 @@ def _parse(argv=None):
     p.add_argument('--np', dest='np_spec', default=None,
                    help='MIN[:MAX] node count for elastic mode')
     p.add_argument('--elastic_poll', type=float, default=1.0)
+    p.add_argument('--ckpt_dir', default=None,
+                   help='checkpoint directory (utils.checkpoint layout): '
+                        'before each lifetime the launcher finds the latest '
+                        'VERIFIED step, advertises it through the elastic '
+                        'KVStore, and exports the membership-agreed restore '
+                        'point as PADDLE_RESUME_STEP to the children')
     p.add_argument('--log_dir', default=None)
     p.add_argument('training_script')
     p.add_argument('training_script_args', nargs=argparse.REMAINDER)
@@ -80,6 +86,23 @@ def _kill(proc):
 
 
 _shutdown_requested = False
+
+
+def _agree_resume_step(ckpt_dir, mgr):
+    """Latest locally-verified checkpoint step, reconciled with elastic
+    peers (min over live members' advertisements) so every re-ranked worker
+    restores the same state. Returns None when no verified step exists."""
+    from ..utils.checkpoint import latest_verified_step
+    step = latest_verified_step(ckpt_dir)
+    if mgr is None:
+        return step
+    if step is not None:
+        mgr.advertise_step(step)
+    agreed = mgr.agreed_step()
+    if agreed is not None and agreed != step:
+        print(f'[launch] resume point: local verified step {step}, '
+              f'membership agreed {agreed}', file=sys.stderr)
+    return agreed if agreed is not None else step
 
 
 def _run_group(cmd, envs, hb_paths, hb_timeout, stop_check=None):
@@ -210,6 +233,11 @@ def main(argv=None):
                 nnodes, node_rank = args.nnodes, args.node_rank
                 stop_check = None
             envs = _build_envs(args, nproc, nnodes, node_rank)
+            if args.ckpt_dir:
+                agreed = _agree_resume_step(args.ckpt_dir, mgr)
+                if agreed is not None:
+                    for env in envs:
+                        env['PADDLE_RESUME_STEP'] = str(agreed)
             cmd = ([sys.executable, args.training_script]
                    + args.training_script_args)
             start = time.time()

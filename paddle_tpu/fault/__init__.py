@@ -1,0 +1,27 @@
+"""paddle_tpu.fault — framework-level fault tolerance primitives.
+
+- retry():          bounded retries with backoff/jitter/deadline
+- CircuitBreaker:   stop hammering a dependency that is down
+- inject():         env-controlled fault points for the chaos harness
+- typed errors:     CheckpointCorruptError, UnsafePayloadError, RetryError,
+                    CircuitOpenError, InjectedFault
+
+Used by framework_io (atomic verified checkpoints), utils.checkpoint
+(save retries), io.DataLoader (transient __getitem__ retries + native-pool
+degrade), utils.download (fetch retries), and the elastic launcher/manager
+(heartbeat outage surfacing). See tools/chaos_check.py for the end-to-end
+crash/resume proof.
+"""
+from .errors import (CheckpointCorruptError, CircuitOpenError, InjectedFault,  # noqa: F401
+                     RetryError, UnsafePayloadError)
+from .retry import retry  # noqa: F401
+from .circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: F401
+from .inject import (active_points, configure, fired_count, inject,  # noqa: F401
+                     reload)
+
+__all__ = [
+    'retry', 'RetryError',
+    'CircuitBreaker', 'CircuitOpenError', 'CLOSED', 'OPEN', 'HALF_OPEN',
+    'inject', 'configure', 'reload', 'active_points', 'fired_count',
+    'InjectedFault', 'CheckpointCorruptError', 'UnsafePayloadError',
+]

@@ -1,0 +1,102 @@
+"""Circuit breaker: stop hammering a dependency that is down.
+
+closed --(failure_threshold consecutive failures)--> open
+open   --(recovery_timeout elapsed)-->               half_open
+half_open --success--> closed   |   --failure--> open (timer restarts)
+
+The clock is injectable so state transitions are deterministic in tests.
+"""
+import threading
+import time
+
+from .errors import CircuitOpenError
+
+CLOSED = 'closed'
+OPEN = 'open'
+HALF_OPEN = 'half_open'
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold=5, recovery_timeout=30.0,
+                 half_open_max_calls=1, clock=None):
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_timeout = recovery_timeout
+        self.half_open_max_calls = max(1, half_open_max_calls)
+        self._clock = clock or time.monotonic
+        self._lock = threading.RLock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = None
+        self._trial_calls = 0
+
+    # ---- state ----------------------------------------------------------
+    @property
+    def state(self):
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self):
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.recovery_timeout:
+            self._state = HALF_OPEN
+            self._trial_calls = 0
+
+    def _open(self):
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._failures = 0
+
+    def reset(self):
+        with self._lock:
+            self._state = CLOSED
+            self._failures = 0
+            self._opened_at = None
+            self._trial_calls = 0
+
+    # ---- accounting -----------------------------------------------------
+    def allow(self):
+        """Reserve permission for one call. In half-open only
+        ``half_open_max_calls`` trial calls get through."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN:
+                if self._trial_calls < self.half_open_max_calls:
+                    self._trial_calls += 1
+                    return True
+                return False
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            if self._state in (HALF_OPEN, OPEN):
+                self.reset()
+
+    def record_failure(self):
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == HALF_OPEN:
+                self._open()
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._open()
+
+    # ---- call wrapper ---------------------------------------------------
+    def call(self, fn, *args, **kwargs):
+        if not self.allow():
+            with self._lock:
+                remaining = self.recovery_timeout - \
+                    (self._clock() - self._opened_at) \
+                    if self._opened_at is not None else self.recovery_timeout
+            raise CircuitOpenError(max(0.0, remaining))
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result

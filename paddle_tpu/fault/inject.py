@@ -1,0 +1,98 @@
+"""Env-controlled fault injection — the chaos hooks behind tools/chaos_check.
+
+Armed via ``PADDLE_FAULT_INJECT="point:prob[:action],..."`` where action is
+``raise`` (default: raise InjectedFault, exercising retry/degrade paths) or
+``kill`` (SIGKILL the process mid-operation, exercising crash recovery).
+``PADDLE_FAULT_SEED`` makes firing decisions reproducible;
+``PADDLE_FAULT_MAX`` caps how many faults fire per process.
+
+Instrumented points: ``ckpt.write`` / ``ckpt.commit`` (framework_io.save,
+before the payload / manifest os.replace), ``dataloader.step`` (per batch),
+``collective.entry`` (all_reduce/all_gather/broadcast/barrier), and
+``store.heartbeat`` (elastic membership beat).
+
+When no spec is armed, ``inject()`` is a single falsy-dict check — zero cost
+on hot paths.
+"""
+import os
+import random
+import signal
+
+from .errors import InjectedFault
+
+ENV_SPEC = 'PADDLE_FAULT_INJECT'
+ENV_SEED = 'PADDLE_FAULT_SEED'
+ENV_MAX = 'PADDLE_FAULT_MAX'
+
+_points = {}            # point -> (probability, action)
+_rng = random.Random()
+_max_faults = None
+_fired = 0
+
+
+def _parse(spec):
+    out = {}
+    for part in (spec or '').split(','):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(':')
+        if len(fields) < 2:
+            raise ValueError(
+                f'bad fault spec {part!r}: want point:prob[:action]')
+        point, prob = fields[0], float(fields[1])
+        action = fields[2] if len(fields) > 2 else 'raise'
+        if action not in ('raise', 'kill'):
+            raise ValueError(f'bad fault action {action!r} in {part!r}')
+        out[point] = (prob, action)
+    return out
+
+
+def configure(spec=None, seed=None, max_faults=None):
+    """Programmatic arming (tests); ``configure(None)`` disarms."""
+    global _points, _rng, _max_faults, _fired
+    _points = _parse(spec) if isinstance(spec, str) else dict(spec or {})
+    _rng = random.Random(seed)
+    _max_faults = max_faults
+    _fired = 0
+
+
+def reload():
+    """Re-read the PADDLE_FAULT_* environment (called once at import)."""
+    seed = os.environ.get(ENV_SEED)
+    mx = os.environ.get(ENV_MAX)
+    configure(os.environ.get(ENV_SPEC),
+              seed=int(seed) if seed else None,
+              max_faults=int(mx) if mx else None)
+
+
+def active_points():
+    return dict(_points)
+
+
+def fired_count():
+    return _fired
+
+
+def inject(point):
+    """Fire the armed fault at ``point`` (probabilistically); no-op when
+    disarmed. Place at the entry of any operation whose failure the caller
+    claims to survive."""
+    if not _points:
+        return
+    ent = _points.get(point)
+    if ent is None:
+        return
+    global _fired
+    if _max_faults is not None and _fired >= _max_faults:
+        return
+    prob, action = ent
+    if _rng.random() >= prob:
+        return
+    _fired += 1
+    if action == 'kill':
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise InjectedFault(point)
+
+
+reload()

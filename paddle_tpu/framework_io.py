@@ -1,19 +1,45 @@
 """paddle.save / paddle.load — state dicts and nested pytrees of tensors,
 stored as a pickle of numpy arrays (.pdparams/.pdopt compatible role).
 
-Reference: python/paddle/framework/io.py.
+Reference: python/paddle/framework/io.py. Crash-safe extensions:
+
+- ``save`` is atomic and durable: payload is written to a temp file,
+  fsync'd, then ``os.replace``'d over the target, so a SIGKILL mid-save
+  can never truncate an existing checkpoint. A sidecar JSON manifest
+  (``<path>.manifest``) records the format version, payload size/CRC32 and
+  per-array CRC32/dtype/shape.
+- ``load`` verifies the manifest and raises a typed
+  ``fault.CheckpointCorruptError`` on any mismatch instead of unpickling
+  garbage. Given a *directory*, it falls back to the newest intact
+  checkpoint inside it.
+- unpickling is restricted to numpy + a small builtins allowlist, so
+  loading an untrusted ``.pdparams`` cannot execute arbitrary code
+  (``fault.UnsafePayloadError``).
 """
+import io
+import json
 import os
 import pickle
+import zlib
 
 import numpy as np
 
 from .core.tensor import Tensor
+from .fault import CheckpointCorruptError, UnsafePayloadError
+from .fault.inject import inject
+
+FORMAT_VERSION = 1
+MANIFEST_SUFFIX = '.manifest'
 
 
 def _to_numpy(obj):
+    import jax
     if isinstance(obj, Tensor):
         return ('__tensor__', np.asarray(obj._value))
+    if isinstance(obj, jax.Array):
+        # device arrays pickle as opaque jax objects the restricted
+        # unpickler (rightly) refuses; persist them as host numpy
+        return np.asarray(obj)
     if isinstance(obj, dict):
         return {k: _to_numpy(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -33,14 +59,230 @@ def _from_numpy(obj):
     return obj
 
 
+# ---- integrity manifest -----------------------------------------------------
+
+def _walk_arrays(obj, prefix, out):
+    """Deterministic (path, ndarray) walk — identical on save and load."""
+    if isinstance(obj, np.ndarray):
+        out.append((prefix, obj))
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _walk_arrays(v, f'{prefix}.{k}' if prefix else str(k), out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _walk_arrays(v, f'{prefix}[{i}]', out)
+
+
+def _array_crc(a):
+    if a.dtype == object:
+        return None
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+
+
+def _build_manifest(payload_obj, payload):
+    leaves = []
+    _walk_arrays(payload_obj, '', leaves)
+    return {
+        'format_version': FORMAT_VERSION,
+        'payload_size': len(payload),
+        'payload_crc32': zlib.crc32(payload) & 0xFFFFFFFF,
+        'arrays': [{'key': k,
+                    'crc32': _array_crc(a),
+                    'dtype': str(a.dtype),
+                    'shape': list(a.shape)} for k, a in leaves],
+    }
+
+
+def _write_fsync(path, data):
+    with open(path, 'wb') as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(d):
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sweep_stale_tmps(path):
+    """Remove torn ``{path}*.tmp.<pid>`` debris left by a process that was
+    killed mid-save (its finally-block never ran). Safe: tmp names are
+    pid-scoped and a new save of the same path supersedes any older
+    in-flight write."""
+    d = os.path.dirname(path) or '.'
+    base = os.path.basename(path)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        if name.startswith(base) and '.tmp.' in name:
+            try:
+                os.remove(os.path.join(d, name))
+            except OSError:
+                pass
+
+
 def save(obj, path, protocol=4, **configs):
+    """Atomic durable save: tmp file -> fsync -> os.replace, with a sidecar
+    integrity manifest. A crash at any instant leaves either the previous
+    complete checkpoint or the new complete one — never a truncated mix."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, 'wb') as f:
-        pickle.dump(_to_numpy(obj), f, protocol=protocol)
+    payload_obj = _to_numpy(obj)
+    payload = pickle.dumps(payload_obj, protocol=protocol)
+    manifest = json.dumps(_build_manifest(payload_obj, payload),
+                          sort_keys=True).encode()
+    tmp = f'{path}.tmp.{os.getpid()}'
+    mtmp = f'{path}{MANIFEST_SUFFIX}.tmp.{os.getpid()}'
+    _sweep_stale_tmps(path)
+    try:
+        _write_fsync(tmp, payload)
+        _write_fsync(mtmp, manifest)
+        inject('ckpt.write')
+        os.replace(tmp, path)
+        inject('ckpt.commit')
+        os.replace(mtmp, path + MANIFEST_SUFFIX)
+        _fsync_dir(d or '.')
+    finally:
+        for t in (tmp, mtmp):
+            try:
+                os.remove(t)
+            except OSError:
+                pass
+
+
+# ---- restricted unpickling --------------------------------------------------
+
+# numpy's pickle reduction moved core modules around across versions; allow
+# both spellings. ml_dtypes carries TPU dtypes (bfloat16 & friends) that
+# appear inside array dtype pickles under amp.
+_SAFE_MODULES = {'numpy', 'numpy.core.multiarray', 'numpy._core.multiarray',
+                 'numpy.core.numeric', 'numpy._core.numeric', 'ml_dtypes'}
+_SAFE_BUILTINS = {'complex', 'set', 'frozenset', 'slice', 'range',
+                  'bytearray'}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module in _SAFE_MODULES:
+            return super().find_class(module, name)
+        if module == 'builtins' and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise UnsafePayloadError(
+            f'refusing to unpickle global {module}.{name} — checkpoints may '
+            f'only contain numpy data (untrusted pickles can execute code)')
+
+
+def _restricted_loads(data):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# ---- verified load ----------------------------------------------------------
+
+def _read_manifest(path):
+    mpath = path + MANIFEST_SUFFIX
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, 'rb') as f:
+            return json.loads(f.read().decode())
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(path, f'unreadable manifest: {e!r}') \
+            from e
+
+
+def _load_file(path):
+    with open(path, 'rb') as f:
+        data = f.read()
+    m = _read_manifest(path)
+    if m is not None:
+        if m.get('format_version', 0) > FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                path, f"format_version {m.get('format_version')} is newer "
+                      f'than supported {FORMAT_VERSION}')
+        if m.get('payload_size') != len(data):
+            raise CheckpointCorruptError(
+                path, f"size mismatch: manifest says {m.get('payload_size')} "
+                      f'bytes, file has {len(data)}')
+        if m.get('payload_crc32') != (zlib.crc32(data) & 0xFFFFFFFF):
+            raise CheckpointCorruptError(path, 'payload CRC32 mismatch')
+    try:
+        obj = _restricted_loads(data)
+    except UnsafePayloadError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(path, f'undecodable payload: {e!r}') \
+            from e
+    if m is not None:
+        leaves = []
+        _walk_arrays(obj, '', leaves)
+        want = m.get('arrays', [])
+        if len(leaves) != len(want):
+            raise CheckpointCorruptError(
+                path, f'array count mismatch: manifest {len(want)}, '
+                      f'payload {len(leaves)}')
+        for (key, a), w in zip(leaves, want):
+            if key != w['key'] or str(a.dtype) != w['dtype'] \
+                    or list(a.shape) != w['shape']:
+                raise CheckpointCorruptError(
+                    path, f'array {key!r} does not match manifest entry '
+                          f"{w['key']!r} ({w['dtype']}, {w['shape']})")
+            crc = _array_crc(a)
+            if w['crc32'] is not None and crc != w['crc32']:
+                raise CheckpointCorruptError(
+                    path, f'array {key!r} CRC32 mismatch')
+    return _from_numpy(obj)
+
+
+def _checkpoint_candidates(dirpath):
+    """Checkpoint files in ``dirpath``, newest first (step number when the
+    name carries one, else mtime)."""
+    import re
+    out = []
+    for name in os.listdir(dirpath):
+        p = os.path.join(dirpath, name)
+        if not os.path.isfile(p) or name.endswith(MANIFEST_SUFFIX) \
+                or '.tmp.' in name:
+            continue
+        m = re.search(r'(\d+)', name)
+        step = int(m.group(1)) if m else -1
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            continue
+        out.append((step, mtime, p))
+    out.sort(key=lambda t: (t[0], t[1]), reverse=True)
+    return [p for _, _, p in out]
+
+
+def _load_newest(dirpath):
+    errors = []
+    for p in _checkpoint_candidates(dirpath):
+        try:
+            return _load_file(p)
+        except (CheckpointCorruptError, UnsafePayloadError, OSError) as e:
+            errors.append(f'{os.path.basename(p)}: {e}')
+    raise CheckpointCorruptError(
+        dirpath, 'no intact checkpoint found'
+                 + (f' (tried: {"; ".join(errors[:4])})' if errors else ''))
 
 
 def load(path, **configs):
-    with open(path, 'rb') as f:
-        return _from_numpy(pickle.load(f))
+    """Verified load. ``path`` may be a checkpoint file (manifest-checked
+    when a sidecar exists; legacy manifest-less files still load, through
+    the restricted unpickler) or a directory of checkpoints (falls back to
+    the newest intact one)."""
+    if os.path.isdir(path):
+        return _load_newest(path)
+    return _load_file(path)

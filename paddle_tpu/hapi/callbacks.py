@@ -132,6 +132,133 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, 'final'))
 
 
+class AutoResume(Callback):
+    """Durable training checkpoints + automatic restore on (re)start.
+
+    Writes atomic, CRC-verified checkpoints (``ckpt-<global_step>.pdckpt``
+    via utils.checkpoint.CheckpointManager) holding params, optimizer
+    state, RNG state and progress meta (epoch / step-in-epoch /
+    global_step / shuffle seed). On train begin it restores the newest
+    intact checkpoint — so an elastic relaunch or plain rerun continues
+    mid-run instead of restarting from step 0. ``Model.fit(resume=...)``
+    is sugar for installing this callback.
+
+    If the launcher advertised an agreed restore point through the
+    elastic KVStore (env ``PADDLE_RESUME_STEP``), restores the newest
+    checkpoint at or below it so re-ranked workers agree.
+
+    - ``every_n_steps``: additionally checkpoint every N train batches
+      (step-granular resume; epoch-end checkpoints always happen per
+      ``save_freq``).
+    - ``keep_period``: steps divisible by it survive GC forever.
+    """
+
+    def __init__(self, directory, every_n_steps=None, save_freq=1,
+                 max_to_keep=3, keep_period=None, save_retries=3, verbose=0):
+        super().__init__()
+        from ..utils.checkpoint import CheckpointManager
+        self.directory = directory
+        self.every_n_steps = every_n_steps
+        self.save_freq = max(1, save_freq)
+        self.verbose = verbose
+        self.mgr = CheckpointManager(directory, max_to_keep=max_to_keep,
+                                     keep_period=keep_period,
+                                     save_retries=save_retries)
+        self.resume_info = None
+        self.seed_base = 0
+        self._gstep = 0
+        self._epoch = 0
+
+    # ---- restore --------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        self.resume_info = None
+        self.seed_base = int(np.random.randint(0, 2 ** 31))
+        cap = os.environ.get('PADDLE_RESUME_STEP')
+        cap = int(cap) if cap else None
+        from ..fault import CheckpointCorruptError
+        for step in reversed(self.mgr.all_steps()):
+            if cap is not None and step > cap:
+                continue
+            try:
+                state = self.mgr.restore(step)
+            except (CheckpointCorruptError, OSError):
+                continue              # fall back to the next older intact one
+            self._apply(state)
+            return
+
+    def _apply(self, state):
+        import jax
+        import jax.numpy as jnp
+        model = self.model
+        model.network.set_state_dict(state['params'])
+        opt = state.get('opt')
+        if opt is not None and model._optimizer is not None:
+            model._opt_state = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+                opt)
+            model._opt_restored = True
+        meta = state.get('meta', {})
+        self._gstep = int(meta.get('global_step', 0))
+        self.seed_base = int(meta.get('seed_base', self.seed_base))
+        if meta.get('rng') is not None:
+            from ..tensor.random import set_rng_state
+            set_rng_state(jnp.asarray(meta['rng']))
+        if meta.get('lr') is not None and model._optimizer is not None:
+            try:
+                model._optimizer.set_lr(float(meta['lr']))
+            except Exception:         # schedulers own their own lr
+                pass
+        self.resume_info = {'epoch': int(meta.get('epoch', 0)),
+                            'step': meta.get('step'),
+                            'global_step': self._gstep}
+        if self.verbose:
+            print(f'[AutoResume] restored global step {self._gstep} '
+                  f'from {self.directory}')
+
+    # ---- save -----------------------------------------------------------
+    def _state(self, step_in_epoch):
+        import jax
+        model = self.model
+        meta = {'epoch': self._epoch, 'step': step_in_epoch,
+                'global_step': self._gstep, 'seed_base': self.seed_base}
+        from ..tensor.random import get_rng_state
+        meta['rng'] = np.asarray(get_rng_state())
+        if model._optimizer is not None:
+            try:
+                meta['lr'] = float(model._optimizer.get_lr())
+            except Exception:
+                pass
+        state = {'params': model.network.state_dict(), 'meta': meta}
+        if getattr(model, '_opt_state', None) is not None:
+            state['opt'] = jax.tree_util.tree_map(np.asarray,
+                                                  model._opt_state)
+        return state
+
+    def _save(self, step_in_epoch):
+        import warnings
+        try:
+            self.mgr.save(self._gstep, self._state(step_in_epoch))
+        except Exception as e:        # RetryError after exhausted retries:
+            warnings.warn(            # keep training, next save may succeed
+                f'AutoResume: checkpoint at step {self._gstep} failed '
+                f'after retries: {e!r}')
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._gstep += 1
+        if self.every_n_steps and self._gstep % self.every_n_steps == 0:
+            self._save(step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq == 0:
+            self._save(None)
+
+    def on_train_end(self, logs=None):
+        self._save(None)
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         super().__init__()

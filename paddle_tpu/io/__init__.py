@@ -274,7 +274,16 @@ class DataLoader:
             raise TypeError('length of IterableDataset DataLoader unknown')
         return len(self.batch_sampler)
 
+    def _fetch(self, i):
+        """dataset[i] with up to 3 attempts — transient errors (flaky remote
+        storage, a racy augmentation) retry with a short backoff instead of
+        killing the epoch."""
+        from ..fault import retry
+        return retry(lambda: self.dataset[i], retries=3, backoff=0.05,
+                     jitter=0.5)
+
     def _iter_sync(self):
+        from ..fault.inject import inject
         if self._iterable_mode:
             it = iter(self.dataset)
             while True:
@@ -283,18 +292,52 @@ class DataLoader:
                     return
                 if len(batch) < self.batch_size and self.drop_last:
                     return
+                inject('dataloader.step')
                 yield self.collate_fn(batch)
         else:
             for idxs in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in idxs])
+                inject('dataloader.step')
+                yield self.collate_fn([self._fetch(i) for i in idxs])
+
+    def _warn_native(self, exc, what):
+        if not getattr(self, '_native_warned', False):
+            self._native_warned = True
+            import warnings
+            warnings.warn(
+                f'native DataLoader worker pool {what} ({exc!r}); degrading '
+                f'to synchronous iteration', RuntimeWarning, stacklevel=2)
+
+    def _iter_native_fallback(self):
+        """Native C++ worker pool with graceful degrade: if the pool cannot
+        start or dies mid-epoch, finish the epoch synchronously from the
+        first undelivered batch — one warning, no data loss."""
+        from ..fault.inject import inject
+        try:
+            from .native_loader import NativeWorkerIterator
+            it = NativeWorkerIterator(self)
+        except Exception as e:
+            self._warn_native(e, 'unavailable')
+            yield from self._iter_sync()
+            return
+        delivered = 0
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            except Exception as e:
+                self._warn_native(e, 'failed mid-epoch')
+                for idxs in it.batches[delivered:]:
+                    inject('dataloader.step')
+                    yield self.collate_fn([self._fetch(i) for i in idxs])
+                return
+            delivered += 1
+            inject('dataloader.step')
+            yield batch
 
     def __iter__(self):
-        if self.num_workers > 0:
-            try:
-                from .native_loader import NativeWorkerIterator
-                return NativeWorkerIterator(self)
-            except Exception:
-                pass
+        if self.num_workers > 0 and not self._iterable_mode:
+            return self._iter_native_fallback()
         return self._iter_sync()
 
 

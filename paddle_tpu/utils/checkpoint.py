@@ -1,15 +1,75 @@
-"""Distributed checkpoint/resume (orbax-backed).
+"""Distributed checkpoint/resume with crash-safe persistence.
 
 Reference capability: fleet checkpoint utilities + fluid io.save/load_persistables
-for sharded training state. TPU-native: orbax async checkpointing is
-sharding-aware — each host writes its own shards, restore re-places arrays on
-the mesh. ``CheckpointManager`` adds keep-policies and auto-resume (the
-elastic-recovery story together with distributed/launch.py's restart loop).
+for sharded training state. Two backends behind one manager API:
+
+- ``local`` (default): every step is ONE atomic, manifest-verified file
+  (``ckpt-<step>.pdckpt``) written through framework_io.save (tmp -> fsync
+  -> os.replace + CRC32 sidecar). ``latest_step()`` only reports steps that
+  pass verification, so a checkpoint truncated by a crash is never chosen
+  as the resume point. Saves are retried via fault.retry.
+- ``orbax``: sharding-aware async checkpointing (each host writes its own
+  shards, restore re-places arrays on the mesh) for multi-host TPU jobs.
+
+Keep policy: ``max_to_keep`` newest steps survive garbage collection; steps
+divisible by ``keep_period`` are kept forever (durable milestones an
+operator can always roll back to).
 """
 import os
+import re
 
-import jax
 import numpy as np
+
+from ..fault import CheckpointCorruptError, retry
+
+_STEP_RE = re.compile(r'^ckpt-(\d+)\.pdckpt$')
+
+
+def _step_path(directory, step):
+    return os.path.join(directory, f'ckpt-{int(step)}.pdckpt')
+
+
+def _verify_file(path):
+    """Cheap integrity check: manifest size+CRC when a sidecar exists,
+    full restricted load otherwise. -> bool."""
+    from .. import framework_io as fio
+    try:
+        with open(path, 'rb') as f:
+            data = f.read()
+        m = fio._read_manifest(path)
+        if m is not None:
+            import zlib
+            return (m.get('payload_size') == len(data)
+                    and m.get('payload_crc32') == (zlib.crc32(data)
+                                                   & 0xFFFFFFFF))
+        fio._load_file(path)
+        return True
+    except Exception:
+        return False
+
+
+def list_steps(directory):
+    """All step numbers present on disk (verified or not), ascending."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for n in names:
+        m = _STEP_RE.match(n)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_verified_step(directory):
+    """Newest step whose checkpoint passes integrity verification, or None.
+    This is the value the elastic launcher advertises through the KVStore
+    so re-ranked workers agree on a restore point."""
+    for step in reversed(list_steps(directory)):
+        if _verify_file(_step_path(directory, step)):
+            return step
+    return None
 
 
 def _ocp():
@@ -17,30 +77,77 @@ def _ocp():
     return ocp
 
 
-class CheckpointManager:
-    def __init__(self, directory, max_to_keep=3):
-        ocp = _ocp()
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
-        opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                            create=True)
-        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+class _LocalBackend:
+    def __init__(self, directory, max_to_keep, keep_period):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.keep_period = keep_period
 
-    def save(self, step, state, wait=False):
-        """state: pytree of jax arrays (params/opt_state/buffers/meta)."""
+    def save(self, step, state):
+        from .. import framework_io as fio
+        fio.save(state, _step_path(self.directory, step))
+        self._gc()
+
+    def _gc(self):
+        steps = list_steps(self.directory)
+        if self.max_to_keep is None or len(steps) <= self.max_to_keep:
+            return
+        drop = steps[:-self.max_to_keep] if self.max_to_keep else steps
+        for s in drop:
+            if self.keep_period and s % self.keep_period == 0:
+                continue
+            for suffix in ('', '.manifest'):
+                try:
+                    os.remove(_step_path(self.directory, s) + suffix)
+                except OSError:
+                    pass
+
+    def latest_step(self):
+        return latest_verified_step(self.directory)
+
+    def all_steps(self):
+        return list_steps(self.directory)
+
+    def restore(self, step, template):
+        from .. import framework_io as fio
+        out = fio.load(_step_path(self.directory, step))
+        if template is not None:
+            import jax
+            import jax.numpy as jnp
+            out = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+                out)
+        return out
+
+    def wait(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class _OrbaxBackend:
+    def __init__(self, directory, max_to_keep, keep_period):
+        ocp = _ocp()
+        kw = {'max_to_keep': max_to_keep, 'create': True}
+        if keep_period:
+            kw['keep_period'] = keep_period
+        self._mgr = ocp.CheckpointManager(directory,
+                                          options=ocp.CheckpointManagerOptions(
+                                              **kw))
+
+    def save(self, step, state):
         ocp = _ocp()
         self._mgr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            self._mgr.wait_until_finished()
 
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def restore(self, step=None, template=None):
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, step, template):
         ocp = _ocp()
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            return None
         if template is not None:
             return self._mgr.restore(step,
                                      args=ocp.args.StandardRestore(template))
@@ -51,6 +158,46 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+class CheckpointManager:
+    def __init__(self, directory, max_to_keep=3, keep_period=None,
+                 save_retries=3, backend='local'):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.save_retries = max(1, save_retries)
+        if backend == 'orbax':
+            self._be = _OrbaxBackend(self.directory, max_to_keep, keep_period)
+        else:
+            self._be = _LocalBackend(self.directory, max_to_keep, keep_period)
+
+    def save(self, step, state, wait=False):
+        """state: pytree of arrays (params/opt_state/buffers/meta). Retried
+        on transient write errors; atomic either way (a crash mid-save never
+        clobbers the previous step)."""
+        retry(lambda: self._be.save(step, state),
+              retries=self.save_retries, backoff=0.1, jitter=0.25)
+        if wait:
+            self._be.wait()
+
+    def latest_step(self):
+        """Newest VERIFIED step (local backend verifies CRC manifests)."""
+        return self._be.latest_step()
+
+    def all_steps(self):
+        return self._be.all_steps()
+
+    def restore(self, step=None, template=None):
+        step = step if step is not None else self._be.latest_step()
+        if step is None:
+            return None
+        return self._be.restore(step, template)
+
+    def wait(self):
+        self._be.wait()
+
+    def close(self):
+        self._be.close()
 
 
 def save_checkpoint(path, state, step=0):
@@ -67,15 +214,19 @@ def load_checkpoint(path, template=None):
 
 
 def auto_resume(path, init_fn, template=None):
-    """Elastic-recovery entry: restore the newest checkpoint if one exists,
-    else build fresh state with init_fn(). Returns (state, start_step)."""
+    """Elastic-recovery entry: restore the newest INTACT checkpoint if one
+    exists, else build fresh state with init_fn(). Returns
+    (state, start_step). A corrupt newest checkpoint falls back to the next
+    older intact one rather than failing the job."""
     try:
         mgr = CheckpointManager(path)
-        step = mgr.latest_step()
-        if step is not None:
-            state = mgr.restore(step, template=template)
-            mgr.close()
-            return state, step + 1
+        for step in reversed(mgr.all_steps()):
+            try:
+                state = mgr.restore(step, template=template)
+                mgr.close()
+                return state, step + 1
+            except (CheckpointCorruptError, OSError):
+                continue
         mgr.close()
     except Exception:
         pass

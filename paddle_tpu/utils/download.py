@@ -2,21 +2,59 @@
 Reference: python/paddle/utils/download.py (get_weights_path_from_url /
 get_path_from_url with md5 check + decompress).
 
-This deployment is zero-egress: URLs resolve against the local cache
-(``~/.cache/paddle_tpu/<basename>``) that an operator pre-populates; a
+This deployment is zero-egress by default: URLs resolve against the local
+cache (``~/.cache/paddle_tpu/<basename>``) that an operator pre-populates; a
 missing cache entry raises with the exact path to provision instead of
 attempting a network fetch. md5 verification and tar/zip decompression
 behave like the reference.
+
+Deployments that DO allow egress install a fetch hook::
+
+    from paddle_tpu.utils import download
+    download.FETCHER = my_fetch     # callable(url, dest_path)
+
+Fetches then run through ``fault.retry`` — exponential backoff with jitter
+and a total deadline (``RETRY`` dict tunes them) — and land atomically
+(tmp file + os.replace), so a crashed fetch never leaves a truncated cache
+entry that later resolves as valid.
 """
 import hashlib
 import os
 import tarfile
 import zipfile
 
+from ..fault import retry
+
 __all__ = ['get_weights_path_from_url']
 
 WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle_tpu/weights')
 DOWNLOAD_HOME = os.path.expanduser('~/.cache/paddle_tpu/downloads')
+
+# fetch hook: None keeps the zero-egress behavior; set to callable(url, path)
+FETCHER = None
+# retry policy for flaky fetches (total attempts / seconds; test-tunable)
+RETRY = {'retries': 4, 'backoff': 0.5, 'factor': 2.0, 'jitter': 0.5,
+         'deadline': 120.0}
+
+
+def _fetch(url, path):
+    """FETCHER with bounded retries; atomic into the cache."""
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    tmp = f'{path}.tmp.{os.getpid()}'
+
+    def attempt():
+        FETCHER(url, tmp)
+        if not os.path.exists(tmp):
+            raise IOError(f'fetcher produced no file for {url!r}')
+
+    try:
+        retry(attempt, **RETRY)
+        os.replace(tmp, path)
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def is_url(path):
@@ -60,9 +98,13 @@ def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
     else:
         path = os.path.join(root_dir, url.split('/')[-1])
     if not os.path.exists(path):
-        raise FileNotFoundError(
-            f'{path} not found and network fetch is disabled (zero-egress '
-            f'deployment). Provision the file at that path to use {url!r}.')
+        if FETCHER is not None and is_url(url):
+            _fetch(url, path)
+        else:
+            raise FileNotFoundError(
+                f'{path} not found and network fetch is disabled (zero-egress '
+                f'deployment). Provision the file at that path to use '
+                f'{url!r}.')
     if not _md5check(path, md5sum):
         raise IOError(f'{path} md5 mismatch (expected {md5sum})')
     return _decompress(path) if decompress else path

@@ -1,0 +1,593 @@
+"""Fault-tolerance suite: retry/backoff under a fake clock, circuit-breaker
+state machine, fault injection, atomic+verified checkpoints (byte flips,
+truncation, malicious pickles, kill-during-save), AutoResume continuity,
+heartbeat degradation, DataLoader graceful degrade, download retry.
+
+Deterministic by construction: every timing-sensitive primitive takes an
+injectable clock/sleep/rng; crash tests run the victim in a subprocess.
+"""
+import json
+import os
+import pickle
+import random
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.fault import (CheckpointCorruptError, CircuitBreaker,
+                              CircuitOpenError, InjectedFault, RetryError,
+                              UnsafePayloadError, retry)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    fault.configure(None)
+
+
+class FakeClock:
+    """Deterministic time source: ``sleep`` advances ``time`` and records."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+# ---- retry ---------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    clk = FakeClock()
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise IOError('transient')
+        return 'ok'
+
+    out = retry(flaky, retries=5, backoff=1.0, factor=2.0,
+                clock=clk.time, sleep=clk.sleep)
+    assert out == 'ok'
+    assert calls['n'] == 3
+    assert clk.sleeps == [1.0, 2.0]       # backoff * factor**(attempt-1)
+
+
+def test_retry_exhausts_and_chains_cause():
+    clk = FakeClock()
+    err = ValueError('always')
+
+    def bad():
+        raise err
+
+    with pytest.raises(RetryError) as ei:
+        retry(bad, retries=3, backoff=0.5, clock=clk.time, sleep=clk.sleep)
+    assert ei.value.attempts == 3
+    assert ei.value.__cause__ is err
+    assert ei.value.last_exception is err
+    assert clk.sleeps == [0.5, 1.0]       # no sleep after the final attempt
+
+
+def test_retry_deadline_aborts_before_crossing():
+    clk = FakeClock()
+
+    def bad():
+        raise IOError('down')
+
+    with pytest.raises(RetryError) as ei:
+        retry(bad, retries=10, backoff=2.0, factor=2.0, deadline=2.5,
+              clock=clk.time, sleep=clk.sleep)
+    # first delay 2.0 fits (0+2 <= 2.5); second delay 4.0 would cross
+    assert clk.sleeps == [2.0]
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.__cause__, IOError)
+
+
+def test_retry_jitter_deterministic_with_seeded_rng():
+    clk = FakeClock()
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise IOError('x')
+        return 1
+
+    retry(flaky, retries=5, backoff=1.0, factor=1.0, jitter=0.5,
+          clock=clk.time, sleep=clk.sleep, rng=random.Random(0))
+    ref = random.Random(0)
+    want = [1.0 * (1.0 + 0.5 * ref.random()) for _ in range(2)]
+    assert clk.sleeps == pytest.approx(want)
+
+
+def test_retry_non_listed_exception_propagates():
+    def bad():
+        raise KeyError('nope')
+
+    with pytest.raises(KeyError):
+        retry(bad, retries=5, exceptions=(IOError,))
+
+
+def test_retry_max_backoff_caps_delay():
+    clk = FakeClock()
+
+    def bad():
+        raise IOError('x')
+
+    with pytest.raises(RetryError):
+        retry(bad, retries=5, backoff=10.0, factor=10.0, max_backoff=15.0,
+              clock=clk.time, sleep=clk.sleep)
+    assert clk.sleeps == [10.0, 15.0, 15.0, 15.0]
+
+
+# ---- circuit breaker -----------------------------------------------------
+
+def test_circuit_opens_after_threshold_and_recovers():
+    clk = FakeClock()
+    cb = CircuitBreaker(failure_threshold=2, recovery_timeout=10.0,
+                        clock=clk.time)
+    assert cb.state == 'closed'
+    cb.record_failure()
+    assert cb.state == 'closed'
+    cb.record_failure()
+    assert cb.state == 'open'
+
+    with pytest.raises(CircuitOpenError) as ei:
+        cb.call(lambda: 'never')
+    assert 0.0 <= ei.value.retry_after <= 10.0
+
+    clk.now += 10.0                        # recovery timeout elapses
+    assert cb.state == 'half_open'
+    assert cb.call(lambda: 'probe') == 'probe'    # trial call succeeds
+    assert cb.state == 'closed'
+
+
+def test_circuit_half_open_failure_reopens():
+    clk = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, recovery_timeout=5.0,
+                        clock=clk.time)
+    cb.record_failure()
+    assert cb.state == 'open'
+    clk.now += 5.0
+    assert cb.state == 'half_open'
+    with pytest.raises(IOError):
+        cb.call(lambda: (_ for _ in ()).throw(IOError('still down')))
+    assert cb.state == 'open'              # timer restarted
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: 1)
+
+
+def test_circuit_half_open_limits_trial_calls():
+    clk = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, recovery_timeout=1.0,
+                        half_open_max_calls=1, clock=clk.time)
+    cb.record_failure()
+    clk.now += 1.0
+    assert cb.allow() is True              # the one trial slot
+    assert cb.allow() is False             # concurrent probes refused
+
+
+# ---- fault injection -----------------------------------------------------
+
+def test_inject_disarmed_is_noop():
+    fault.configure(None)
+    fault.inject('ckpt.write')             # must not raise
+    assert fault.active_points() == {}
+    assert fault.fired_count() == 0
+
+
+def test_inject_raise_action_fires():
+    fault.configure('ckpt.write:1.0', seed=0)
+    with pytest.raises(InjectedFault) as ei:
+        fault.inject('ckpt.write')
+    assert ei.value.point == 'ckpt.write'
+    fault.inject('other.point')            # unarmed point: no-op
+
+
+def test_inject_probability_zero_never_fires():
+    fault.configure('dataloader.step:0.0', seed=1)
+    for _ in range(100):
+        fault.inject('dataloader.step')
+    assert fault.fired_count() == 0
+
+
+def test_inject_max_faults_caps_firing():
+    fault.configure('p:1.0', seed=0, max_faults=2)
+    fired = 0
+    for _ in range(10):
+        try:
+            fault.inject('p')
+        except InjectedFault:
+            fired += 1
+    assert fired == 2
+    assert fault.fired_count() == 2
+
+
+def test_inject_bad_spec_rejected():
+    with pytest.raises(ValueError):
+        fault.configure('justapoint')
+    with pytest.raises(ValueError):
+        fault.configure('p:0.5:explode')
+
+
+# ---- checkpoint integrity ------------------------------------------------
+
+def _sample_state():
+    return {'w': np.arange(12, dtype='float32').reshape(3, 4),
+            'b': np.ones(3, dtype='float32'),
+            'meta': {'epoch': 2, 'name': 'x'}}
+
+
+def test_save_writes_manifest_with_crcs(tmp_path):
+    path = str(tmp_path / 'ck.pdckpt')
+    paddle.save(_sample_state(), path)
+    man = json.load(open(path + '.manifest'))
+    assert man['format_version'] == 1
+    assert man['payload_size'] == os.path.getsize(path)
+    assert man['payload_crc32'] == zlib.crc32(open(path, 'rb').read())
+    arrays = {a['key']: a for a in man['arrays']}
+    assert arrays['w']['shape'] == [3, 4]
+    assert arrays['w']['dtype'] == 'float32'
+    got = paddle.load(path)
+    np.testing.assert_array_equal(got['w'], _sample_state()['w'])
+
+
+def test_byte_flip_detected(tmp_path):
+    path = str(tmp_path / 'ck.pdckpt')
+    paddle.save(_sample_state(), path)
+    raw = bytearray(open(path, 'rb').read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, 'wb').write(bytes(raw))
+    with pytest.raises(CheckpointCorruptError):
+        paddle.load(path)
+
+
+def test_truncation_detected(tmp_path):
+    path = str(tmp_path / 'ck.pdckpt')
+    paddle.save(_sample_state(), path)
+    raw = open(path, 'rb').read()
+    open(path, 'wb').write(raw[:len(raw) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        paddle.load(path)
+
+
+def test_malicious_pickle_rejected(tmp_path):
+    path = str(tmp_path / 'evil.pdckpt')
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ('echo pwned',))
+
+    with open(path, 'wb') as f:
+        pickle.dump({'payload': Evil()}, f)
+    with pytest.raises(UnsafePayloadError):
+        paddle.load(path)
+
+
+def test_directory_load_falls_back_to_intact(tmp_path):
+    from paddle_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    mgr.save(1, {'v': np.array([1.0])})
+    mgr.save(2, {'v': np.array([2.0])})
+    # corrupt the newest
+    newest = str(tmp_path / 'ckpt-2.pdckpt')
+    raw = bytearray(open(newest, 'rb').read())
+    raw[0] ^= 0xFF
+    open(newest, 'wb').write(bytes(raw))
+    got = paddle.load(str(tmp_path))       # directory => newest INTACT
+    np.testing.assert_array_equal(np.asarray(got['v']), [1.0])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_during_save_leaves_previous_checkpoint(tmp_path):
+    """SIGKILL between payload write and commit must leave the prior
+    checkpoint fully loadable and no torn file behind."""
+    path = str(tmp_path / 'ck.pdckpt')
+    child = f'''
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import fault
+paddle.save({{'v': np.array([1.0])}}, {path!r})
+fault.configure('ckpt.write:1.0:kill')
+paddle.save({{'v': np.array([2.0])}}, {path!r})
+'''
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    proc = subprocess.run([sys.executable, '-c', child], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -9, proc.stderr
+    got = paddle.load(path)
+    np.testing.assert_array_equal(np.asarray(got['v']), [1.0])
+    # a SIGKILLed writer leaves torn tmp debris (its cleanup never ran)...
+    assert [f for f in os.listdir(tmp_path) if '.tmp.' in f]
+    # ...which never shadows a directory-granular load...
+    got_dir = paddle.load(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(got_dir['v']), [1.0])
+    # ...and the next save of the same path sweeps it before committing
+    paddle.save({'v': np.array([3.0])}, path)
+    assert [f for f in os.listdir(tmp_path) if '.tmp.' in f] == []
+    np.testing.assert_array_equal(np.asarray(paddle.load(path)['v']), [3.0])
+
+
+def test_checkpoint_manager_keep_period_gc(tmp_path):
+    from paddle_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, keep_period=2)
+    for step in range(6):
+        mgr.save(step, {'v': np.array([float(step)])})
+    # keep_period multiples (0,2,4) survive GC; max_to_keep keeps 4,5
+    assert mgr.all_steps() == [0, 2, 4, 5]
+
+
+def test_checkpoint_save_retries_through_transient_fault(tmp_path):
+    from paddle_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), save_retries=3)
+    fault.configure('ckpt.write:1.0:raise', max_faults=1)
+    mgr.save(7, {'v': np.array([7.0])})     # first attempt faulted, retried
+    fault.configure(None)
+    got = mgr.restore(7)
+    np.testing.assert_array_equal(np.asarray(got['v']), [7.0])
+
+
+def test_latest_verified_step_skips_corrupt(tmp_path):
+    from paddle_tpu.utils.checkpoint import (CheckpointManager,
+                                             latest_verified_step)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    mgr.save(3, {'v': np.array([3.0])})
+    mgr.save(9, {'v': np.array([9.0])})
+    assert latest_verified_step(str(tmp_path)) == 9
+    raw = bytearray(open(tmp_path / 'ckpt-9.pdckpt', 'rb').read())
+    raw[1] ^= 0xFF
+    open(tmp_path / 'ckpt-9.pdckpt', 'wb').write(bytes(raw))
+    assert latest_verified_step(str(tmp_path)) == 3
+
+
+# ---- AutoResume ----------------------------------------------------------
+
+def _make_model():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    model = Model(net)
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    model.prepare(opt, nn.CrossEntropyLoss())
+    return model
+
+
+def _make_loader():
+    rs = np.random.RandomState(0)
+    xs = rs.rand(32, 8).astype('float32')
+    ys = rs.randint(0, 3, 32).astype('int64')
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    return paddle.io.DataLoader(DS(), batch_size=8, shuffle=False)
+
+
+def test_auto_resume_continues_at_right_step(tmp_path):
+    """Train 1 epoch with per-epoch checkpoints, then resume: the second fit
+    must start at epoch 1 and end with weights bit-identical to an
+    uninterrupted 3-epoch run."""
+    from paddle_tpu.hapi.callbacks import AutoResume
+    ckdir = str(tmp_path / 'ck')
+
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=1, verbose=0,
+              callbacks=[AutoResume(ckdir)])
+
+    paddle.seed(0)
+    resumed = _make_model()
+    cb = AutoResume(ckdir)
+    resumed.fit(_make_loader(), epochs=3, verbose=0, callbacks=[cb])
+    assert cb.resume_info is not None
+    assert cb.resume_info['epoch'] == 0            # resumed FROM epoch 0
+    assert cb.resume_info['global_step'] == 4      # 32/8 batches done
+
+    paddle.seed(0)
+    straight = _make_model()
+    straight.fit(_make_loader(), epochs=3, verbose=0)
+
+    got = resumed.network.state_dict()
+    want = straight.network.state_dict()
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]._value),
+                                      np.asarray(want[k]._value), err_msg=k)
+
+
+def test_model_fit_resume_kwarg_installs_callback(tmp_path):
+    from paddle_tpu.hapi.callbacks import AutoResume
+    ckdir = str(tmp_path / 'ck')
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=1, verbose=0, resume=ckdir)
+    assert os.path.exists(os.path.join(ckdir, 'ckpt-4.pdckpt'))
+    # a resumed-of-completed run executes zero additional epochs
+    paddle.seed(0)
+    model2 = _make_model()
+    model2.fit(_make_loader(), epochs=1, verbose=0, resume=ckdir)
+    after = [f for f in os.listdir(ckdir) if f.endswith('.pdckpt')]
+    assert 'ckpt-4.pdckpt' in after
+
+
+def test_resume_step_env_caps_restore(tmp_path, monkeypatch):
+    from paddle_tpu.hapi.callbacks import AutoResume
+    from paddle_tpu.utils.checkpoint import CheckpointManager
+    ckdir = str(tmp_path / 'ck')
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=2, verbose=0,
+              callbacks=[AutoResume(ckdir, every_n_steps=2, max_to_keep=10)])
+    steps = CheckpointManager(ckdir, max_to_keep=10).all_steps()
+    assert len(steps) >= 2
+    cap = steps[-2]
+    monkeypatch.setenv('PADDLE_RESUME_STEP', str(cap))
+    paddle.seed(0)
+    cb = AutoResume(ckdir, max_to_keep=10)
+    model2 = _make_model()
+    model2.fit(_make_loader(), epochs=2, verbose=0, callbacks=[cb])
+    assert cb.resume_info is not None
+    assert cb.resume_info['global_step'] == cap
+
+
+# ---- elastic heartbeat degradation --------------------------------------
+
+def test_heartbeat_degraded_flag_and_warn_once():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.fleet.elastic_store import MemoryStore
+    mgr = ElasticManager(MemoryStore(), heartbeat_fail_limit=3)
+    exc = IOError('store down')
+    with pytest.warns(RuntimeWarning, match='consecutive store failures'):
+        for _ in range(3):
+            mgr._hb_fail(exc)
+    assert mgr.degraded is True
+    assert mgr.hb_consecutive_failures == 3
+    # further failures do NOT warn again
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter('error')
+        mgr._hb_fail(exc)
+    # recovery clears the flag and re-arms the warning
+    mgr._hb_ok()
+    assert mgr.degraded is False
+    assert mgr.hb_consecutive_failures == 0
+    with pytest.warns(RuntimeWarning):
+        for _ in range(3):
+            mgr._hb_fail(exc)
+
+
+def test_elastic_advertise_and_agreed_step():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.fleet.elastic_store import MemoryStore
+    store = MemoryStore()
+    a = ElasticManager(store, node_id='a', heartbeat_interval=0.05)
+    b = ElasticManager(store, node_id='b', heartbeat_interval=0.05)
+    a._touch(), b._touch()
+    a.advertise_step(120)
+    b.advertise_step(100)
+    # the whole job can only restore from state EVERY member has
+    assert a.agreed_step() == 100
+    assert b.agreed_step() == 100
+    b.advertise_step(120)
+    assert a.agreed_step() == 120
+
+
+# ---- DataLoader degradation ----------------------------------------------
+
+def test_dataloader_getitem_transient_retry():
+    fails = {'n': 0}
+
+    class Flaky(paddle.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 3 and fails['n'] < 2:
+                fails['n'] += 1
+                raise IOError('transient read')
+            return np.float32(i)
+
+    loader = paddle.io.DataLoader(Flaky(), batch_size=4, shuffle=False)
+    batches = [np.asarray(b._value) for b in loader]
+    assert fails['n'] == 2                 # retried through both failures
+    np.testing.assert_array_equal(np.concatenate(batches), np.arange(8))
+
+
+def test_dataloader_native_failure_degrades_to_sync(monkeypatch):
+    from paddle_tpu.io import native_loader
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    class DiesMidEpoch:
+        def __init__(self, loader):
+            self.batches = list(loader.batch_sampler)
+            self._n = 0
+
+        def __next__(self):
+            if self._n >= 1:
+                raise RuntimeError('worker pool died')
+            self._n += 1
+            idxs = self.batches[0]
+            return paddle.io.default_collate_fn(
+                [np.float32(i) for i in idxs])
+
+    monkeypatch.setattr(native_loader, 'NativeWorkerIterator', DiesMidEpoch)
+    loader = paddle.io.DataLoader(DS(), batch_size=2, shuffle=False,
+                                  num_workers=2)
+    with pytest.warns(RuntimeWarning, match='degrading to synchronous'):
+        batches = [np.asarray(b._value) for b in loader]
+    # every batch delivered exactly once despite the mid-epoch death
+    np.testing.assert_array_equal(np.concatenate(batches), np.arange(8))
+    # second epoch: warning NOT repeated
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter('error')
+        batches2 = [np.asarray(b._value) for b in loader]
+    np.testing.assert_array_equal(np.concatenate(batches2), np.arange(8))
+
+
+# ---- download retry ------------------------------------------------------
+
+def test_download_flaky_fetcher_retries(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    calls = {'n': 0}
+
+    def flaky(url, dest):
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise IOError('conn reset')
+        with open(dest, 'w') as f:
+            f.write('weights')
+
+    monkeypatch.setattr(download, 'FETCHER', flaky)
+    monkeypatch.setattr(download, 'RETRY',
+                        dict(retries=4, backoff=0.001, jitter=0.0))
+    p = download.get_path_from_url('https://host/w.bin',
+                                   root_dir=str(tmp_path), decompress=False)
+    assert calls['n'] == 3
+    assert open(p).read() == 'weights'
+    assert [f for f in os.listdir(tmp_path) if '.tmp.' in f] == []
+
+
+def test_download_fetcher_exhaustion_raises_retry_error(tmp_path,
+                                                        monkeypatch):
+    from paddle_tpu.utils import download
+
+    def dead(url, dest):
+        raise IOError('refused')
+
+    monkeypatch.setattr(download, 'FETCHER', dead)
+    monkeypatch.setattr(download, 'RETRY',
+                        dict(retries=3, backoff=0.001, jitter=0.0))
+    with pytest.raises(RetryError):
+        download.get_path_from_url('https://host/w.bin',
+                                   root_dir=str(tmp_path), decompress=False)
+
+
+def test_download_zero_egress_without_fetcher(tmp_path):
+    from paddle_tpu.utils import download
+    assert download.FETCHER is None
+    with pytest.raises(FileNotFoundError):
+        download.get_path_from_url('https://host/nope.bin',
+                                   root_dir=str(tmp_path), decompress=False)
