@@ -14,12 +14,17 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .tensor import Tensor, TapeNode, _grad_enabled
+from .tensor import DeviceResidentRef, Tensor, TapeNode, _grad_enabled
 from . import dtype as dtypes
 
 
 def _unwrap(x):
-    return x._value if isinstance(x, Tensor) else x
+    if isinstance(x, Tensor):
+        v = x._value
+        # a device-resident param touched by eager user code: resolve the
+        # live array out of the executor's train state
+        return v.materialize() if type(v) is DeviceResidentRef else v
+    return x
 
 
 def _is_diff_tensor(x):
@@ -89,7 +94,7 @@ def apply_op(pure_fn, *args, **kwargs):
         new_args, new_kwargs = substitute(vals)
         return pure_fn(*new_args, **new_kwargs)
 
-    primals = [t._value for t in diff_tensors]
+    primals = [_unwrap(t) for t in diff_tensors]
     out, vjp_fn = jax.vjp(pure_on_diff, primals)
 
     flat_out, is_seq = (list(out), True) if isinstance(out, (list, tuple)) else ([out], False)

@@ -21,6 +21,103 @@ from . import dtype as dtypes
 
 _state = threading.local()
 
+# Monotone counter bumped on every external in-place Tensor value swap
+# (`_replace_value`): the hapi async executor snapshots it to detect that a
+# parameter/buffer was mutated behind its device-resident TrainState and must
+# be re-captured before the next compiled step.
+_MUTATION_VERSION = 0
+
+
+def mutation_version():
+    return _MUTATION_VERSION
+
+
+def _bump_mutation_version():
+    global _MUTATION_VERSION
+    _MUTATION_VERSION += 1
+
+
+class DeviceResidentRef:
+    """Placeholder standing in for ``Tensor._value`` while the real array
+    lives in a Model's device-resident train state (hapi async executor).
+
+    The executor donates the previous step's param/opt buffers to XLA, so a
+    Tensor must not keep a direct reference to an array that the next step
+    will invalidate. Instead it holds this ref, which resolves the CURRENT
+    array out of the owning store on first touch (``materialize``), writes it
+    back into the owning Tensor, and flags the store so the executor knows to
+    re-install refs before the next donated step. shape/dtype are served
+    statically (donation never changes them) so summary/repr-style metadata
+    reads don't force a device sync.
+    """
+
+    __slots__ = ('_store_obj', '_store_attr', '_key', '_owner', '_shape',
+                 '_dtype')
+
+    def __init__(self, store_obj, store_attr, key, owner, shape, dtype):
+        self._store_obj = store_obj
+        self._store_attr = store_attr
+        self._key = key
+        self._owner = weakref.ref(owner)
+        self._shape = tuple(shape)
+        self._dtype = dtype
+
+    def materialize(self):
+        val = getattr(self._store_obj, self._store_attr)[self._key]
+        self._store_obj.refs_dirty = True
+        owner = self._owner()
+        if owner is not None and owner._value is self:
+            owner._value = val
+        return val
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        out = 1
+        for s in self._shape:
+            out *= int(s)
+        return out
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getattr__(self, name):
+        return getattr(self.materialize(), name)
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+    def __len__(self):
+        return self._shape[0]
+
+    def __float__(self):
+        return float(np.asarray(self.materialize()))
+
+    def __int__(self):
+        return int(np.asarray(self.materialize()))
+
+    def __bool__(self):
+        return builtins_bool(self.materialize())
+
+    def __repr__(self):
+        return (f'DeviceResidentRef({self._store_attr}[{self._key!r}], '
+                f'shape={list(self._shape)}, dtype={self._dtype})')
+
 
 def _grad_enabled():
     return getattr(_state, 'grad_enabled', True)
@@ -198,6 +295,7 @@ class Tensor:
         self._value = new_value if isinstance(new_value, (jax.Array, jax.core.Tracer)) \
             else jnp.asarray(new_value)
         self._node = None
+        _bump_mutation_version()
 
     def set_value(self, value):
         self._replace_value(value)

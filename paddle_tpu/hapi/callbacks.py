@@ -54,10 +54,14 @@ class Callback:
 
 
 class CallbackList:
-    def __init__(self, callbacks=None, model=None, verbose=2):
+    def __init__(self, callbacks=None, model=None, verbose=2, log_freq=10):
         self.callbacks = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in self.callbacks):
-            self.callbacks.insert(0, ProgBarLogger(verbose=verbose))
+            # align the auto-inserted logger with fit()'s log_freq so the
+            # async executor's deferred loss is a resolved float whenever
+            # the progress bar actually prints
+            self.callbacks.insert(0, ProgBarLogger(log_freq=log_freq,
+                                                   verbose=verbose))
         for c in self.callbacks:
             c.set_model(model)
 
@@ -219,6 +223,13 @@ class AutoResume(Callback):
     def _state(self, step_in_epoch):
         import jax
         model = self.model
+        # a checkpoint is a read point for the async executor: settle
+        # in-flight steps and write device-resident state back into the
+        # Layer tree before snapshotting it
+        if hasattr(model, '_drain_inflight'):
+            model._drain_inflight()
+        if hasattr(model, '_sync_train_state'):
+            model._sync_train_state()
         meta = {'epoch': self._epoch, 'step': step_in_epoch,
                 'global_step': self._gstep, 'seed_base': self.seed_base}
         from ..tensor.random import get_rng_state

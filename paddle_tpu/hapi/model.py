@@ -4,15 +4,27 @@ Reference: python/paddle/hapi/model.py. TPU-native core: the whole train step
 (forward + loss + backward + optimizer update) is ONE jitted XLA program over
 the param pytree — the eager tape is bypassed entirely, giving the compiled
 performance path that the reference gets from static graph + Executor.
+
+Async executor: params/buffers/opt_state stay device-resident in a
+``_TrainState`` between steps (no per-batch Python dict rebuild / write-back),
+the compiled step donates them to XLA so updates happen in place, the loss
+comes back as a lazy device array resolved only at logging points, and batches
+are prefetched to the device ahead of compute (``DataLoader.prefetch_to_device``).
+Layer objects get the values written back lazily — on first read, at
+checkpoints, and at fit() exit. ``PADDLE_TPU_SYNC_EXECUTOR=1`` restores the
+fully synchronous per-step behavior.
 """
+import collections
 import math
 import os
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor, no_grad_ctx
+from ..core import tensor as _core_tensor
+from ..core.tensor import DeviceResidentRef, Tensor, no_grad_ctx
 from ..nn.layer_base import Layer, functional_call
 from ..tensor.random import rng_scope, next_key
 from ..io import DataLoader, Dataset
@@ -22,6 +34,19 @@ def _to_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class _TrainState:
+    """Device-resident training state: the single owner of the live
+    param/buffer/opt-state arrays between compiled steps. ``mut_version``
+    snapshots the global Tensor mutation counter so external writes
+    (set_state_dict, user set_value, an eager optimizer) are detected and
+    folded back in before the next step; ``refs_dirty`` marks that some
+    Layer tensor materialized its placeholder and needs a fresh ref before
+    the next donated step invalidates what it is holding."""
+
+    __slots__ = ('params', 'buffers', 'opt_state', 'mut_version',
+                 'refs_dirty')
 
 
 class Model:
@@ -34,8 +59,28 @@ class Model:
         self._metrics = []
         self._train_step = None
         self._eval_step = None
-        self._opt_state = None
+        self._train_steps = {}      # mode signature -> (step, accum, apply)
+        self._eval_steps = {}       # mode signature -> eval step
+        self._tstate = None
+        self._opt_state_host = None
         self._opt_restored = False
+        self._opt_init_pending = True
+        self._grad_acc = None
+        self._accum_count = 0
+        self._net_mode = None
+        self._mode_sig_cache = None
+        self._step_traces = 0
+        self._eval_traces = 0
+        self._last_outputs = None
+        self._inflight = collections.deque()
+        self._scale_cache = None
+        self._step_timer = None
+        self._async = os.environ.get('PADDLE_TPU_SYNC_EXECUTOR') != '1'
+        try:
+            self._inflight_window = max(
+                1, int(os.environ.get('PADDLE_TPU_INFLIGHT', '2')))
+        except ValueError:
+            self._inflight_window = 2
         self.stop_training = False
 
     # ---- setup -----------------------------------------------------------
@@ -45,6 +90,9 @@ class Model:
         self._metrics = _to_list(metrics)
         self._train_step = None
         self._eval_step = None
+        self._train_steps = {}
+        self._eval_steps = {}
+        self._opt_init_pending = True
 
     # ---- functional plumbing --------------------------------------------
     def _pack(self):
@@ -53,18 +101,153 @@ class Model:
         bnames = [n for n, _ in net.named_buffers()]
         return pnames, bnames
 
+    @staticmethod
+    def _real_value(t):
+        v = t._value
+        if type(v) is DeviceResidentRef:
+            return v.materialize()
+        return v if isinstance(v, (jax.Array, jax.core.Tracer)) \
+            else jnp.asarray(v)
+
     def _params_dict(self):
-        return {n: p._value for n, p in self.network.named_parameters()}
+        return {n: self._real_value(p)
+                for n, p in self.network.named_parameters()}
 
     def _buffers_dict(self):
-        return {n: b._value for n, b in self.network.named_buffers()}
+        return {n: self._real_value(b)
+                for n, b in self.network.named_buffers()}
 
-    def _write_back(self, params, buffers):
+    # ---- device-resident train state ------------------------------------
+    @property
+    def _opt_state(self):
+        ts = self._tstate
+        return ts.opt_state if ts is not None else self._opt_state_host
+
+    @_opt_state.setter
+    def _opt_state(self, value):
+        ts = self._tstate
+        if ts is not None:
+            ts.opt_state = value
+        else:
+            self._opt_state_host = value
+
+    def _ensure_tstate(self):
+        """Capture (or reconcile) the device-resident train state. Layer
+        tensors keep only DeviceResidentRef placeholders while the executor
+        owns the arrays; an externally mutated tensor (detected via the
+        global mutation counter) always wins over the captured copy."""
+        ts = self._tstate
+        if (ts is not None
+                and ts.mut_version == _core_tensor.mutation_version()
+                and not (self._async and ts.refs_dirty)):
+            # steady-state fast path: no external mutation, no structural
+            # change (registration paths bump the counter too), and every
+            # Layer tensor still holds its placeholder — nothing to do
+            return ts
+        named_p = list(self.network.named_parameters())
+        named_b = list(self.network.named_buffers())
+        if (ts is None or set(ts.params) != {n for n, _ in named_p}
+                or set(ts.buffers) != {n for n, _ in named_b}):
+            prev_opt = self._opt_state
+            ts = _TrainState()
+            ts.params = {n: self._real_value(p) for n, p in named_p}
+            ts.buffers = {n: self._real_value(b) for n, b in named_b}
+            ts.opt_state = prev_opt
+            ts.mut_version = _core_tensor.mutation_version()
+            ts.refs_dirty = True
+            self._tstate = ts
+        elif ts.mut_version != _core_tensor.mutation_version():
+            for n, p in named_p:
+                v = p._value
+                if type(v) is not DeviceResidentRef and v is not ts.params[n]:
+                    ts.params[n] = v if isinstance(
+                        v, (jax.Array, jax.core.Tracer)) else jnp.asarray(v)
+            for n, b in named_b:
+                v = b._value
+                if type(v) is not DeviceResidentRef and v is not ts.buffers[n]:
+                    ts.buffers[n] = v if isinstance(
+                        v, (jax.Array, jax.core.Tracer)) else jnp.asarray(v)
+            ts.mut_version = _core_tensor.mutation_version()
+        if self._async and ts.refs_dirty:
+            # donation will invalidate the arrays a materialized tensor is
+            # holding — swap the placeholders back in before the next step
+            for n, p in named_p:
+                if type(p._value) is not DeviceResidentRef:
+                    arr = ts.params[n]
+                    p._value = DeviceResidentRef(ts, 'params', n, p,
+                                                 arr.shape, arr.dtype)
+            for n, b in named_b:
+                if type(b._value) is not DeviceResidentRef:
+                    arr = ts.buffers[n]
+                    b._value = DeviceResidentRef(ts, 'buffers', n, b,
+                                                 arr.shape, arr.dtype)
+            ts.refs_dirty = False
+        return ts
+
+    def _sync_train_state(self):
+        """Lazy write-back: put the live device arrays back into the Layer
+        tree (fit exit, save(), checkpoint callbacks). Only placeholders are
+        overwritten — a tensor the user replaced keeps the user's value."""
+        ts = self._tstate
+        if ts is None:
+            return
         for n, p in self.network.named_parameters():
-            p._replace_value(params[n])
+            if type(p._value) is DeviceResidentRef and n in ts.params:
+                p._value = ts.params[n]
+                p._node = None
         for n, b in self.network.named_buffers():
-            if n in buffers:
-                b._replace_value(buffers[n])
+            if type(b._value) is DeviceResidentRef and n in ts.buffers:
+                b._value = ts.buffers[n]
+                b._node = None
+        ts.refs_dirty = True
+
+    def _write_back_from_state(self, ts):
+        """Synchronous-mode write-back: unconditionally push the state's
+        arrays into the Layer tree after every step (legacy behavior)."""
+        for n, p in self.network.named_parameters():
+            if n in ts.params:
+                p._value = ts.params[n]
+                p._node = None
+        for n, b in self.network.named_buffers():
+            if n in ts.buffers:
+                b._value = ts.buffers[n]
+                b._node = None
+
+    def _finish_step(self, loss):
+        if not self._async:
+            self._write_back_from_state(self._tstate)
+            return [np.asarray(loss)]
+        # bounded in-flight window: block on the oldest dispatched step so a
+        # NaN or injected fault surfaces within ~window steps of its batch
+        self._inflight.append(loss)
+        while len(self._inflight) > self._inflight_window:
+            old = self._inflight.popleft()
+            try:
+                old.block_until_ready()
+            except AttributeError:
+                pass
+        return [loss]
+
+    def _drain_inflight(self):
+        while self._inflight:
+            old = self._inflight.popleft()
+            try:
+                old.block_until_ready()
+            except AttributeError:
+                pass
+
+    def _lr_scalar(self):
+        fn = getattr(self._optimizer, '_lr_device', None)
+        if fn is not None:
+            return fn()
+        return jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+
+    def _accum_scale(self, value):
+        cache = self._scale_cache
+        if cache is None or cache[0] != value:
+            cache = (value, jax.device_put(np.float32(value)))
+            self._scale_cache = cache
+        return cache[1]
 
     def _compute_loss(self, outputs, labels):
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
@@ -85,6 +268,8 @@ class Model:
         optimizer.step that sparsity.decorate wraps, so mask re-application
         is traced into the step itself."""
         from ..sparsity import ASPHelper
+        if not ASPHelper._masks:
+            return None          # nothing registered: skip the traversal
         masks = {}
         for n, p in self.network.named_parameters():
             ent = ASPHelper._masks.get(id(p))
@@ -100,6 +285,33 @@ class Model:
         m = self._asp_masks_by_name()
         return tuple(sorted((n, id(v)) for n, v in m.items())) if m else None
 
+    # ---- mode handling ---------------------------------------------------
+    def _enter_mode(self, training):
+        """Hoisted out of the traced step (the old in-trace ``l.training``
+        writes left stale flags baked into the jit cache). The network is
+        flipped only when crossing the train/eval boundary, so fine-grained
+        user overrides (e.g. freezing one BatchNorm with ``bn.eval()``
+        mid-training) persist and simply select a differently-keyed
+        compiled step."""
+        if self._net_mode is not training:
+            if training:
+                self.network.train()
+            else:
+                self.network.eval()
+            self._net_mode = training
+
+    def _mode_sig(self):
+        from ..nn import layer_base as _lb
+        mv = _lb.mode_version()
+        cache = self._mode_sig_cache
+        if cache is not None and cache[0] == mv:
+            return cache[1]
+        sig = tuple(l.training
+                    for l in self.network.sublayers(include_self=True))
+        self._mode_sig_cache = (mv, sig)
+        return sig
+
+    # ---- compiled steps --------------------------------------------------
     def _build_train_step(self):
         net = self.network
         opt = self._optimizer
@@ -111,20 +323,16 @@ class Model:
             return {n: (v * asp_masks[n] if n in asp_masks else v)
                     for n, v in params.items()}
 
-        def set_mode(training):
-            for l in net.sublayers(include_self=True):
-                l.training = training
-
         def loss_and_grads(params, buffers, key, inputs, labels):
             def loss_fn(p):
                 with rng_scope(key):
-                    set_mode(True)
                     out, new_buf = functional_call(net, p, buffers, *inputs)
                 loss = self._compute_loss(out, labels)
                 return loss, (out, new_buf)
             return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
         def step(params, buffers, opt_state, key, lr, inputs, labels):
+            self._step_traces += 1      # trace-time side effect: retraces
             (loss, (out, new_buf)), grads = loss_and_grads(
                 params, buffers, key, inputs, labels)
             new_params, new_state = opt.functional_apply(params, grads,
@@ -144,16 +352,22 @@ class Model:
             new_p, new_s = opt.functional_apply(params, grads, opt_state, lr)
             return remask(new_p), new_s
 
-        self._accum_step = jax.jit(accum_step)
-        self._apply_accum = jax.jit(apply_accum)
-        return jax.jit(step)
+        # donation lets XLA update params/opt-state in place instead of
+        # doubling HBM traffic; params survive accum micro-steps (they are
+        # re-fed to the final apply), so only buffers/grad_acc donate there
+        if self._async:
+            # apply_accum does NOT donate grad_acc: it has no same-shaped
+            # output to alias with (XLA would warn and ignore the donation)
+            return (jax.jit(step, donate_argnums=(0, 1, 2)),
+                    jax.jit(accum_step, donate_argnums=(1, 2)),
+                    jax.jit(apply_accum, donate_argnums=(0, 1)))
+        return jax.jit(step), jax.jit(accum_step), jax.jit(apply_accum)
 
     def _build_eval_step(self):
         net = self.network
 
         def step(params, buffers, key, inputs, labels):
-            for l in net.sublayers(include_self=True):
-                l.training = False
+            self._eval_traces += 1
             with rng_scope(key):
                 out, _ = functional_call(net, params, buffers, *inputs)
             loss = None
@@ -165,92 +379,117 @@ class Model:
 
     def _split_batch(self, batch):
         batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
-        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(np.asarray(b))
-                for b in batch]
+        arrs = [self._as_device(b) for b in batch]
         n_in = len(self._inputs) if self._inputs else (
             len(arrs) - len(self._labels) if self._labels else
             (len(arrs) - 1 if self._loss is not None and len(arrs) > 1 else len(arrs)))
         return arrs[:n_in], arrs[n_in:]
 
+    @staticmethod
+    def _as_device(t):
+        """Tensor/device-array/numpy -> jax array without forcing an extra
+        host round-trip: device arrays pass through untouched, numpy goes
+        through jnp.asarray once (zero-copy where the backend allows)."""
+        if isinstance(t, Tensor):
+            v = t._value
+            return v.materialize() if type(v) is DeviceResidentRef else v
+        if isinstance(t, (jax.Array, jax.core.Tracer)):
+            return t
+        return jnp.asarray(t)
+
     # ---- public batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         from ..distributed.launch import touch_heartbeat
         touch_heartbeat()   # liveness signal for the elastic launcher
-        if self._train_step is not None and \
-                getattr(self, '_asp_sig', None) != self._asp_signature():
+        self._enter_mode(True)
+        sig = self._asp_signature()
+        if self._train_steps and getattr(self, '_asp_sig', None) != sig:
             # prune_model after a warmup fit (the standard ASP recipe):
             # rebuild so the new masks trace into the step
-            self._train_step = None
-        if self._train_step is None:
-            self._asp_sig = self._asp_signature()
-            self._train_step = self._build_train_step()
-            if self._opt_state is None or not self._opt_restored:
-                # a restored opt_state (Model.load / AutoResume) must survive
-                # the lazy first-step build instead of being re-initialized
-                self._opt_state = self._optimizer.functional_init(
-                    self._params_dict())
-        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
-                  for t in _to_list(inputs)]
-        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
-                  for t in _to_list(labels)]
-        params = self._params_dict()
-        buffers = self._buffers_dict()
-        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+            self._train_steps.clear()
+            self._opt_init_pending = True
+        mode_key = self._mode_sig()
+        fns = self._train_steps.get(mode_key)
+        if fns is None:
+            self._asp_sig = sig
+            fns = self._build_train_step()
+            self._train_steps[mode_key] = fns
+        self._train_step, self._accum_step, self._apply_accum = fns
+        ts = self._ensure_tstate()
+        if ts.opt_state is None or (self._opt_init_pending
+                                    and not self._opt_restored):
+            # a restored opt_state (Model.load / AutoResume) must survive
+            # the lazy first-step build instead of being re-initialized
+            ts.opt_state = self._optimizer.functional_init(ts.params)
+        self._opt_init_pending = False
+        inputs = [self._as_device(t) for t in _to_list(inputs)]
+        labels = [self._as_device(t) for t in _to_list(labels)]
+        lr = self._lr_scalar()
+        key = next_key()
         if not update:
             # gradient-merge micro step: accumulate into self._grad_acc
-            if getattr(self, '_grad_acc', None) is None:
-                self._grad_acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+            if self._grad_acc is None:
+                self._grad_acc = jax.tree_util.tree_map(jnp.zeros_like,
+                                                        ts.params)
                 self._accum_count = 0
             loss, out, new_b, self._grad_acc = self._accum_step(
-                params, buffers, self._grad_acc, next_key(),
+                ts.params, ts.buffers, self._grad_acc, key,
                 tuple(inputs), tuple(labels))
+            ts.buffers = new_b
             self._accum_count += 1
-            self._write_back(params, new_b)
             self._last_outputs = out
-            return [np.asarray(loss)]
-        if getattr(self, '_grad_acc', None) is not None:
+            return self._finish_step(loss)
+        if self._grad_acc is not None:
             # final micro step: accumulate then apply averaged grads
             loss, out, new_b, self._grad_acc = self._accum_step(
-                params, buffers, self._grad_acc, next_key(),
+                ts.params, ts.buffers, self._grad_acc, key,
                 tuple(inputs), tuple(labels))
             self._accum_count += 1
-            new_p, self._opt_state = self._apply_accum(
-                params, self._opt_state, self._grad_acc, lr,
-                jnp.asarray(1.0 / self._accum_count, jnp.float32))
-            self._write_back(new_p, new_b)
+            new_p, new_s = self._apply_accum(
+                ts.params, ts.opt_state, self._grad_acc, lr,
+                self._accum_scale(1.0 / self._accum_count))
+            ts.params, ts.buffers, ts.opt_state = new_p, new_b, new_s
             self._grad_acc = None
             self._last_outputs = out
-            return [np.asarray(loss)]
+            return self._finish_step(loss)
         loss, out, new_p, new_b, new_s = self._train_step(
-            params, buffers, self._opt_state, next_key(), lr,
+            ts.params, ts.buffers, ts.opt_state, key, lr,
             tuple(inputs), tuple(labels))
-        self._write_back(new_p, new_b)
-        self._opt_state = new_s
+        ts.params, ts.buffers, ts.opt_state = new_p, new_b, new_s
         self._last_outputs = out
-        return [np.asarray(loss)]
+        return self._finish_step(loss)
 
     def _flush_grad_acc(self):
         """Apply any pending accumulated grads (partial gradient-merge cycle)."""
-        if getattr(self, '_grad_acc', None) is None:
+        if self._grad_acc is None:
             return
-        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        params = self._params_dict()
-        new_p, self._opt_state = self._apply_accum(
-            params, self._opt_state, self._grad_acc, lr,
-            jnp.asarray(1.0 / max(self._accum_count, 1), jnp.float32))
-        self._write_back(new_p, self._buffers_dict())
+        ts = self._ensure_tstate()
+        new_p, new_s = self._apply_accum(
+            ts.params, ts.opt_state, self._grad_acc, self._lr_scalar(),
+            self._accum_scale(1.0 / max(self._accum_count, 1)))
+        ts.params, ts.opt_state = new_p, new_s
         self._grad_acc = None
         self._accum_count = 0
+        if not self._async:
+            self._write_back_from_state(ts)
 
     def eval_batch(self, inputs, labels=None):
-        if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
-        inputs = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
-                  for t in _to_list(inputs)]
-        labels = [t._value if isinstance(t, Tensor) else jnp.asarray(np.asarray(t))
-                  for t in _to_list(labels)]
-        loss, out = self._eval_step(self._params_dict(), self._buffers_dict(),
-                                    next_key(), tuple(inputs), tuple(labels))
+        self._enter_mode(False)
+        mode_key = self._mode_sig()
+        step = self._eval_steps.get(mode_key)
+        if step is None:
+            step = self._build_eval_step()
+            self._eval_steps[mode_key] = step
+        self._eval_step = step
+        if self._tstate is not None:
+            ts = self._ensure_tstate()
+            params, buffers = ts.params, ts.buffers
+        else:
+            params, buffers = self._params_dict(), self._buffers_dict()
+        inputs = [self._as_device(t) for t in _to_list(inputs)]
+        labels = [self._as_device(t) for t in _to_list(labels)]
+        loss, out = step(params, buffers, next_key(),
+                         tuple(inputs), tuple(labels))
         return ([np.asarray(loss)] if loss is not None else None,
                 out)
 
@@ -291,13 +530,15 @@ class Model:
             callbacks.append(ModelCheckpoint(save_freq, save_dir))
         auto_resume = next((c for c in callbacks if isinstance(c, AutoResume)),
                            None)
-        cbks = CallbackList(callbacks, self, verbose=verbose)
+        cbks = CallbackList(callbacks, self, verbose=verbose,
+                            log_freq=log_freq)
         cbks.on_begin('train', {'epochs': epochs,
                                 'steps': len(loader) if hasattr(loader, '__len__') else None,
                                 'metrics': ['loss'] + sum([m.name() if isinstance(m.name(), list)
                                                            else [m.name()] for m in self._metrics], [])})
         it_count = 0
         logs = {}
+        timer = self._step_timer
         start_epoch, skip_steps = 0, 0
         if auto_resume is not None and auto_resume.resume_info:
             info = auto_resume.resume_info
@@ -307,6 +548,7 @@ class Model:
                 start_epoch = info['epoch']
                 skip_steps = info['step'] + 1
             it_count = info.get('global_step', 0)
+        use_prefetch = self._async and isinstance(loader, DataLoader)
         for epoch in range(start_epoch, epochs):
             if auto_resume is not None:
                 # deterministic per-epoch shuffle so a resumed lifetime sees
@@ -319,22 +561,49 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step_idx, batch in enumerate(loader):
-                if epoch == start_epoch and step_idx < skip_steps:
-                    continue          # already trained before the restart
-                cbks.on_batch_begin('train', step_idx, logs)
-                inputs, labels = self._split_batch(batch)
-                do_update = (step_idx + 1) % accumulate_grad_batches == 0
-                loss = self.train_batch(inputs, labels, update=do_update)
-                logs = {'loss': float(loss[0]), 'step': step_idx}
-                self._update_metrics(logs, inputs, labels)
-                cbks.on_batch_end('train', step_idx, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    break
+            prefetch_gen = (loader.prefetch_to_device() if use_prefetch
+                            else None)
+            batch_iter = prefetch_gen if prefetch_gen is not None else loader
+            if timer is not None:
+                batch_iter = timer.timed_iter('data', batch_iter)
+            try:
+                for step_idx, batch in enumerate(batch_iter):
+                    if epoch == start_epoch and step_idx < skip_steps:
+                        continue      # already trained before the restart
+                    cbks.on_batch_begin('train', step_idx, logs)
+                    inputs, labels = self._split_batch(batch)
+                    do_update = (step_idx + 1) % accumulate_grad_batches == 0
+                    if timer is not None:
+                        t0 = time.perf_counter()
+                    loss = self.train_batch(inputs, labels, update=do_update)
+                    if timer is not None:
+                        timer.add('dispatch', time.perf_counter() - t0)
+                    lval = loss[0]
+                    if not self._async or step_idx % log_freq == 0:
+                        # deferred loss readback: the device scalar is only
+                        # resolved to a python float at logging points
+                        if timer is not None:
+                            t0 = time.perf_counter()
+                        lval = float(np.asarray(lval))
+                        if timer is not None:
+                            timer.add('readback', time.perf_counter() - t0)
+                    logs = {'loss': lval, 'step': step_idx}
+                    self._update_metrics(logs, inputs, labels)
+                    cbks.on_batch_end('train', step_idx, logs)
+                    if timer is not None:
+                        timer.step_done()
+                    it_count += 1
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+            finally:
+                if prefetch_gen is not None:
+                    prefetch_gen.close()   # stop the producer thread
             # flush a partial gradient-merge cycle so stale grads never leak
             # into the next epoch (or a later fit call) with a wrong divisor
             self._flush_grad_acc()
+            self._drain_inflight()
+            if 'loss' in logs and not isinstance(logs['loss'], float):
+                logs['loss'] = float(np.asarray(logs['loss']))
             from ..optimizer.lr import LRScheduler, ReduceOnPlateau
             if isinstance(self._optimizer._lr, LRScheduler) and \
                     not isinstance(self._optimizer._lr, ReduceOnPlateau):
@@ -345,13 +614,18 @@ class Model:
             cbks.on_epoch_end(epoch, logs)
             if self.stop_training:
                 break
+        # fit() exit is a read point: device-resident state flows back into
+        # the Layer objects before user code (or on_train_end callbacks,
+        # e.g. the final ModelCheckpoint) can look at them
+        self._drain_inflight()
+        self._sync_train_state()
         cbks.on_end('train', logs)
 
     def _update_metrics(self, logs, inputs, labels):
         if not self._metrics or not labels:
             return
         # reuse the forward outputs already computed inside the train step
-        out = getattr(self, '_last_outputs', None)
+        out = self._last_outputs
         if out is None:
             preds = self.predict_batch([Tensor(i) for i in inputs])
             first = jnp.asarray(preds[0])
@@ -416,6 +690,8 @@ class Model:
     # ---- persistence -----------------------------------------------------
     def save(self, path, training=True):
         from ..framework_io import save as fsave
+        self._drain_inflight()
+        self._sync_train_state()
         fsave(self.network.state_dict(), path + '.pdparams')
         if training and self._optimizer is not None:
             opt_state = {'opt_state': jax.tree_util.tree_map(np.asarray, self._opt_state)

@@ -246,6 +246,28 @@ def default_collate_fn(batch):
     return batch
 
 
+def _host_collate_fn(batch):
+    """default_collate_fn without the Tensor wrap: stacks to plain numpy.
+    Built for background-thread collation — the consumer side of
+    ``prefetch_to_device`` does one explicit device_put per array, so the
+    producer must not touch the device at all."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    import numbers
+    if (isinstance(sample, numbers.Number)
+            or isinstance(sample, (np.number, np.bool_))):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_host_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _host_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
@@ -339,6 +361,105 @@ class DataLoader:
         if self.num_workers > 0 and not self._iterable_mode:
             return self._iter_native_fallback()
         return self._iter_sync()
+
+    def prefetch_to_device(self, n=2):
+        """Double-buffered device prefetch: a background thread fetches and
+        collates batch N+1 on the host while the consumer computes on batch
+        N; each batch is explicitly device_put so the steady-state train
+        loop performs no implicit host->device uploads. Yields the same
+        (Tensor-wrapped) batches plain iteration would, in the same order.
+
+        The batch index order is snapshotted on the CALLING thread — the
+        samplers consume ``np.random`` state, which must stay on the main
+        thread for AutoResume's deterministic per-epoch shuffle to replay.
+        """
+        import collections
+        import queue
+        import threading
+
+        import jax
+
+        from ..fault.inject import inject
+
+        depth = max(1, int(n))
+        if self._iterable_mode or self.num_workers > 0:
+            host_iter = iter(self)
+        else:
+            batches = list(self.batch_sampler)
+            host_collate = (_host_collate_fn
+                            if self.collate_fn is default_collate_fn
+                            else self.collate_fn)
+
+            def _host_gen():
+                for idxs in batches:
+                    inject('dataloader.step')
+                    yield host_collate([self._fetch(i) for i in idxs])
+
+            host_iter = _host_gen()
+
+        stop = threading.Event()
+        q = queue.Queue(maxsize=depth)
+        _END, _ERR = object(), object()
+
+        def _put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _produce():
+            try:
+                for b in host_iter:
+                    if not _put((None, b)):
+                        return
+                _put((_END, None))
+            except BaseException as e:   # relayed and re-raised by consumer
+                _put((_ERR, e))
+
+        def _to_device(b):
+            if isinstance(b, Tensor):
+                return Tensor(jax.device_put(np.asarray(b._value)))
+            if isinstance(b, np.ndarray):
+                return Tensor(jax.device_put(b))
+            if isinstance(b, (list, tuple)):
+                return type(b)(_to_device(x) for x in b)
+            if isinstance(b, dict):
+                return {k: _to_device(v) for k, v in b.items()}
+            return b
+
+        def _gen():
+            thread = threading.Thread(target=_produce, daemon=True,
+                                      name='prefetch_to_device')
+            thread.start()
+            pending = collections.deque()
+            done = False
+            try:
+                while True:
+                    # keep up to ``depth`` batches already on device so the
+                    # next step's inputs are resident before dispatch
+                    while not done and len(pending) < depth:
+                        tag, payload = q.get()
+                        if tag is _END:
+                            done = True
+                        elif tag is _ERR:
+                            raise payload
+                        else:
+                            pending.append(_to_device(payload))
+                    if not pending:
+                        return
+                    yield pending.popleft()
+            finally:
+                stop.set()
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+
+        return _gen()
 
 
 def get_worker_info():

@@ -15,8 +15,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor, no_grad_ctx
+from ..core.tensor import Tensor, _bump_mutation_version, no_grad_ctx
 from ..core import dtype as dtypes
+
+
+# Bumped whenever any Layer's ``training`` flag is written. The hapi
+# executor caches its train/eval mode signature (the jit-cache key) against
+# this counter instead of re-walking the layer tree every batch.
+_MODE_VERSION = 0
+
+
+def mode_version():
+    return _MODE_VERSION
 
 
 class Parameter(Tensor):
@@ -84,14 +94,23 @@ class Layer:
                 raise RuntimeError('call super().__init__() first')
             params[name] = value
             self.__dict__.pop(name, None)
+            _bump_mutation_version()   # structural change: new/replaced param
         elif isinstance(value, Layer):
             if subs is None:
                 raise RuntimeError('call super().__init__() first')
             subs[name] = value
             self.__dict__.pop(name, None)
+            _bump_mutation_version()   # structural change: new/replaced layer
         elif bufs is not None and name in bufs:
             bufs[name] = value if isinstance(value, Tensor) or value is None else Tensor(value)
+            # buffer REPLACEMENT (BatchNorm running stats in eager forward)
+            # swaps the Tensor object without _replace_value — bump the
+            # mutation counter so a device-resident train state reconciles
+            _bump_mutation_version()
         else:
+            if name == 'training':
+                global _MODE_VERSION
+                _MODE_VERSION += 1
             object.__setattr__(self, name, value)
 
     def __getattr__(self, name):
@@ -106,6 +125,7 @@ class Layer:
             d = self.__dict__.get(store)
             if d is not None and name in d:
                 del d[name]
+                _bump_mutation_version()
                 return
         object.__delattr__(self, name)
 
@@ -134,10 +154,12 @@ class Layer:
 
     def add_parameter(self, name, parameter):
         self._parameters[name] = parameter
+        _bump_mutation_version()
         return parameter
 
     def add_sublayer(self, name, sublayer):
         self._sub_layers[name] = sublayer
+        _bump_mutation_version()
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
@@ -145,6 +167,7 @@ class Layer:
         if not persistable:
             self._non_persistable_buffer_names.add(name)
         self.__dict__.pop(name, None)
+        _bump_mutation_version()
 
     # -- traversal --------------------------------------------------------
     def named_sublayers(self, prefix='', include_self=False, layers_set=None):
@@ -306,15 +329,24 @@ class _HookRemover:
 
 # -- functional bridge ----------------------------------------------------
 
+def _live_value(t):
+    from ..core.tensor import DeviceResidentRef
+    v = t._value
+    return v.materialize() if type(v) is DeviceResidentRef else v
+
+
 def param_arrays(layer: Layer):
-    """Ordered dict name -> jax array for all trainable params."""
-    return collections.OrderedDict(
-        (n, p._value) for n, p in layer.named_parameters())
+    """dict name -> jax array for all trainable params (insertion-ordered).
+
+    Plain dict, NOT OrderedDict: jax registers them as different pytree
+    node types, and a train step fed ``{}`` once and an OrderedDict the
+    next call silently retraces."""
+    return {n: _live_value(p) for n, p in layer.named_parameters()}
 
 
 def buffer_arrays(layer: Layer):
-    return collections.OrderedDict(
-        (n, b._value) for n, b in layer.named_buffers() if b is not None)
+    return {n: _live_value(b) for n, b in layer.named_buffers()
+            if b is not None}
 
 
 @contextlib.contextmanager
@@ -366,8 +398,10 @@ def functional_call_method(layer: Layer, fn, params, buffers, *args, **kwargs):
             out = fn(*targs, **kwargs)
         new_buffers = buffer_arrays(layer)
         if buffers is not None:
-            new_buffers = collections.OrderedDict(
-                (k, v) for k, v in new_buffers.items() if k in buffers)
+            # plain dict (see param_arrays): an OrderedDict is a different
+            # pytree node type than the {} fed on the first call → retrace
+            new_buffers = {k: v for k, v in new_buffers.items()
+                           if k in buffers}
     return jax.tree_util.tree_map(
         lambda x: x._value if isinstance(x, Tensor) else x, out,
         is_leaf=lambda x: isinstance(x, Tensor)), new_buffers

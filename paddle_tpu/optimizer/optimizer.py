@@ -70,6 +70,7 @@ class Optimizer:
         self._states = {}           # id(param) -> state dict of jax arrays
         self._step_fn = None
         self._accumulated = 0
+        self._lr_cache = None       # (float value, device scalar)
 
     # ---- hyper-params -------------------------------------------------
     def get_lr(self):
@@ -79,6 +80,21 @@ class Optimizer:
 
     def set_lr(self, value):
         self._lr = value
+        self._lr_cache = None
+
+    def _lr_device(self):
+        """Current LR as a cached f32 device scalar. The per-step hot loop
+        feeds this straight into the compiled train step — the host->device
+        upload happens only when the scheduler actually changes the value,
+        not every batch. Value-keyed so LRScheduler.step()/ReduceLROnPlateau
+        invalidate it without any coupling to the scheduler classes."""
+        import numpy as _np
+        val = float(self.get_lr())
+        cache = self._lr_cache
+        if cache is None or cache[0] != val:
+            cache = (val, jax.device_put(_np.float32(val)))
+            self._lr_cache = cache
+        return cache[1]
 
     @property
     def _learning_rate(self):

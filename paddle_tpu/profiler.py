@@ -132,6 +132,72 @@ class ProfilerOptions:
         return self._options[name]
 
 
+class StepTimer:
+    """Per-step host-side timing breakdown for the async train executor.
+
+    Phases: ``data`` (host fetch/collate + H2D wait), ``dispatch`` (python
+    overhead to enqueue the compiled step — what the async executor
+    minimizes), ``readback`` (blocking D2H loss resolution at logging
+    points). Attach with ``model._step_timer = StepTimer()`` before fit();
+    read ``summary()`` after."""
+
+    PHASES = ('data', 'dispatch', 'readback')
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._samples = {p: [] for p in self.PHASES}
+        self._pending = {p: 0.0 for p in self.PHASES}
+        self.steps = 0
+        self._t_start = time.perf_counter()
+
+    def add(self, phase, seconds):
+        self._pending[phase] = self._pending.get(phase, 0.0) + seconds
+
+    @contextlib.contextmanager
+    def span(self, phase):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(phase, time.perf_counter() - t0)
+
+    def timed_iter(self, phase, iterable):
+        """Wrap an iterator so the time blocked in next() books to phase."""
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            finally:
+                self.add(phase, time.perf_counter() - t0)
+            yield item
+
+    def step_done(self):
+        for p, v in self._pending.items():
+            self._samples.setdefault(p, []).append(v)
+        self._pending = {p: 0.0 for p in self._samples}
+        self.steps += 1
+
+    def summary(self):
+        wall = time.perf_counter() - self._t_start
+        out = {'steps': self.steps,
+               'wall_s': wall,
+               'steps_per_sec': self.steps / wall if wall > 0 else 0.0}
+        for p, xs in self._samples.items():
+            if not xs:
+                continue
+            s = sorted(xs)
+            out[p + '_ms_mean'] = 1e3 * sum(xs) / len(xs)
+            out[p + '_ms_p50'] = 1e3 * s[len(s) // 2]
+            out[p + '_ms_p99'] = 1e3 * s[min(len(s) - 1,
+                                             int(len(s) * 0.99))]
+        return out
+
+
 _profiler_singleton = None
 
 

@@ -1,0 +1,416 @@
+"""Async train-step executor: device-resident state, donation, deferred
+readback, prefetch, and the guard-rails that keep all of it semantically
+invisible (lazy write-back, bit-exact resume, sync escape hatch)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import DeviceResidentRef
+from paddle_tpu.hapi import Model
+
+
+def _make_model(lr=1e-2):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    model = Model(net)
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=lr)
+    model.prepare(opt, nn.CrossEntropyLoss())
+    return model
+
+
+def _data(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = rs.rand(n, 8).astype('float32')
+    ys = rs.randint(0, 3, n).astype('int64')
+    return xs, ys
+
+
+def _make_loader(batch_size=8):
+    xs, ys = _data()
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    return paddle.io.DataLoader(DS(), batch_size=batch_size, shuffle=False)
+
+
+# ---- zero implicit transfers in the steady state -------------------------
+
+def test_train_batch_steady_loop_no_implicit_transfers():
+    """After warm-up, the inner loop must not fall back to implicit
+    host<->device copies: uploads of the lr scalar, numpy inputs, or python
+    ints would all trip the transfer guard."""
+    paddle.seed(0)
+    model = _make_model()
+    xs, ys = _data()
+    dev = [(jax.device_put(xs[i:i + 8]), jax.device_put(ys[i:i + 8]))
+           for i in range(0, 32, 8)]
+    for i in range(2):                        # warm-up: compile + capture
+        model.train_batch([dev[i][0]], [dev[i][1]])
+    with jax.transfer_guard('disallow'):
+        for i in range(5):
+            x, y = dev[i % len(dev)]
+            loss = model.train_batch([x], [y])
+    model._drain_inflight()
+    assert np.isfinite(float(np.asarray(loss[0])))
+
+
+def test_fit_steady_state_no_implicit_transfers():
+    """Same property at the fit() level: with prefetch_to_device feeding the
+    loop (explicit device_put only) and log_freq past the epoch length, a
+    whole guarded epoch runs transfer-clean."""
+    from paddle_tpu.hapi.callbacks import Callback
+
+    class Guard(Callback):
+        def __init__(self):
+            super().__init__()
+            self.armed = False
+
+        def on_epoch_begin(self, epoch, logs=None):
+            if epoch >= 1 and not self.armed:     # epoch 0 warms everything
+                jax.config.update('jax_transfer_guard', 'disallow')
+                self.armed = True
+
+        def on_train_end(self, logs=None):
+            jax.config.update('jax_transfer_guard', 'allow')
+
+    paddle.seed(0)
+    model = _make_model()
+    try:
+        model.fit(_make_loader(), epochs=3, verbose=0, log_freq=100,
+                  callbacks=[Guard()])
+    finally:
+        jax.config.update('jax_transfer_guard', 'allow')
+    p = next(iter(model.network.parameters()))
+    assert np.isfinite(np.asarray(p._value)).all()
+
+
+# ---- retrace behavior ----------------------------------------------------
+
+def test_step_compiles_exactly_once_across_fit():
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=3, verbose=0)
+    assert model._step_traces == 1
+
+
+def test_mode_freeze_retraces_and_stops_stat_updates():
+    """Hoisted mode handling: freezing one BatchNorm between batches keys a
+    SECOND compiled step (old code mutated l.training inside the trace, so
+    the stale flag survived in the jit cache) and its running stats stop
+    updating."""
+    paddle.seed(0)
+    bn = nn.BatchNorm1D(16)
+    net = nn.Sequential(nn.Linear(8, 16), bn, nn.Linear(16, 3))
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(parameters=net.parameters(),
+                                       learning_rate=1e-2),
+                  nn.CrossEntropyLoss())
+    xs, ys = _data()
+    model.train_batch([xs[:8]], [ys[:8]])
+    model.train_batch([xs[8:16]], [ys[8:16]])
+    assert model._step_traces == 1
+    rm_before = np.array(np.asarray(bn._mean._value))
+    bn.eval()                                  # user freezes just this layer
+    model.train_batch([xs[16:24]], [ys[16:24]])
+    assert model._step_traces == 2             # differently-keyed step
+    assert len(model._train_steps) == 2
+    model.train_batch([xs[24:]], [ys[24:]])
+    assert model._step_traces == 2             # second mode also cached
+    rm_after = np.asarray(bn._mean._value)
+    np.testing.assert_array_equal(rm_before, rm_after)
+
+    bn.train()                                 # unfreeze: back to cache hit
+    model.train_batch([xs[:8]], [ys[:8]])
+    assert model._step_traces == 2
+    assert not np.array_equal(rm_before, np.asarray(bn._mean._value))
+
+
+# ---- input conversion ----------------------------------------------------
+
+def test_split_batch_passes_device_arrays_through():
+    model = _make_model()
+    x = jnp.ones((4, 8), jnp.float32)
+    y = jnp.zeros((4,), jnp.int64)
+    inputs, labels = model._split_batch([x, y])
+    assert inputs[0] is x and labels[0] is y   # no host round-trip
+
+    xn = np.ones((4, 8), np.float32)
+    inputs, _ = model._split_batch([xn, y])
+    assert isinstance(inputs[0], jax.Array)
+
+
+# ---- donation + restore stay bit-exact -----------------------------------
+
+def test_donation_autoresume_restore_bit_exact(tmp_path):
+    """Interrupted-and-resumed training must match a straight run down to
+    the last bit — params, optimizer state, and RNG all survive donation
+    and the device-resident state."""
+    from paddle_tpu.hapi.callbacks import AutoResume
+    ckdir = str(tmp_path / 'ck')
+
+    paddle.seed(0)
+    first = _make_model()
+    first.fit(_make_loader(), epochs=1, verbose=0,
+              callbacks=[AutoResume(ckdir)])
+
+    paddle.seed(0)
+    resumed = _make_model()
+    resumed.fit(_make_loader(), epochs=3, verbose=0,
+                callbacks=[AutoResume(ckdir)])
+
+    paddle.seed(0)
+    straight = _make_model()
+    straight.fit(_make_loader(), epochs=3, verbose=0)
+
+    got = resumed.network.state_dict()
+    want = straight.network.state_dict()
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]._value),
+                                      np.asarray(want[k]._value), err_msg=k)
+    got_opt = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, resumed._opt_state))
+    want_opt = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, straight._opt_state))
+    assert len(got_opt) == len(want_opt) > 0
+    for g, w in zip(got_opt, want_opt):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_async_sync_parity_bit_exact(tmp_path):
+    """The executor is a scheduling change, not a numerics change: the same
+    seed and data produce bit-identical weights with and without it."""
+    path = str(tmp_path / 'm')
+
+    paddle.seed(0)
+    m_async = _make_model()
+    assert m_async._async
+    m_async.fit(_make_loader(), epochs=2, verbose=0)
+
+    paddle.seed(0)
+    m_sync = _make_model()
+    m_sync._async = False
+    m_sync.fit(_make_loader(), epochs=2, verbose=0)
+
+    got = m_async.network.state_dict()
+    want = m_sync.network.state_dict()
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]._value),
+                                      np.asarray(want[k]._value), err_msg=k)
+    del path
+
+
+# ---- lazy write-back -----------------------------------------------------
+
+def test_params_lazily_materialize_mid_fit():
+    """Reading a param mid-fit (metrics, debugging, a checkpoint callback)
+    resolves the live device value even though the previous step donated
+    the old buffer — and training continues unharmed afterwards."""
+    from paddle_tpu.hapi.callbacks import Callback
+    seen = []
+
+    class Peek(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if step == 1:
+                p = next(iter(self.model.network.parameters()))
+                seen.append(np.array(p.numpy()))
+
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=2, verbose=0, callbacks=[Peek()])
+    assert len(seen) == 2 and all(np.isfinite(s).all() for s in seen)
+    for _, p in model.network.named_parameters():
+        # fit() exit wrote real arrays back into the Layer tree
+        assert not isinstance(p._value, DeviceResidentRef)
+        assert np.isfinite(np.asarray(p._value)).all()
+
+
+def test_params_hold_refs_during_async_steps():
+    paddle.seed(0)
+    model = _make_model()
+    xs, ys = _data()
+    model.train_batch([xs[:8]], [ys[:8]])
+    p = next(iter(model.network.parameters()))
+    assert type(p._value) is DeviceResidentRef
+    assert p._value.shape == tuple(model._tstate.params[
+        next(n for n, _ in model.network.named_parameters())].shape)
+    val = np.asarray(p._value)                # materializes on read
+    assert np.isfinite(val).all()
+
+
+def test_external_param_write_wins_over_state():
+    """set_value / set_state_dict between steps must override the captured
+    device state, not be silently clobbered by it."""
+    paddle.seed(0)
+    model = _make_model()
+    xs, ys = _data()
+    model.train_batch([xs[:8]], [ys[:8]])
+    name, p = next(iter(model.network.named_parameters()))
+    forced = np.full(p.shape, 0.5, np.float32)
+    p._replace_value(jnp.asarray(forced))
+    model.train_batch([xs[8:16]], [ys[8:16]])
+    # the step consumed the forced value: state diverged from it by one
+    # adam update, not by two (and is not the pre-write trajectory)
+    now = np.asarray(model._tstate.params[name])
+    assert np.abs(now - forced).max() < 0.1
+
+
+# ---- deferred loss + in-flight window ------------------------------------
+
+def test_loss_is_lazy_and_inflight_bounded():
+    paddle.seed(0)
+    model = _make_model()
+    xs, ys = _data()
+    for i in range(6):
+        j = (i % 4) * 8
+        loss = model.train_batch([xs[j:j + 8]], [ys[j:j + 8]])
+    assert isinstance(loss[0], jax.Array)      # not resolved to numpy
+    assert len(model._inflight) <= model._inflight_window
+    model._drain_inflight()
+    assert not model._inflight
+
+
+def test_sync_executor_escape_hatch(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_SYNC_EXECUTOR', '1')
+    paddle.seed(0)
+    model = _make_model()
+    assert not model._async
+    xs, ys = _data()
+    loss = model.train_batch([xs[:8]], [ys[:8]])
+    assert isinstance(loss[0], np.ndarray)     # eager readback
+    for _, p in model.network.named_parameters():
+        assert not isinstance(p._value, DeviceResidentRef)
+
+
+# ---- lr device cache -----------------------------------------------------
+
+def test_lr_device_scalar_cached_and_invalidated():
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[])
+    a = opt._lr_device()
+    b = opt._lr_device()
+    assert a is b                              # no re-upload per step
+    opt.set_lr(0.05)
+    c = opt._lr_device()
+    assert c is not a and float(np.asarray(c)) == pytest.approx(0.05)
+
+
+def test_lr_device_follows_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[])
+    assert float(np.asarray(opt._lr_device())) == pytest.approx(0.1)
+    sched.step()
+    assert float(np.asarray(opt._lr_device())) == pytest.approx(0.05)
+
+
+# ---- device prefetch -----------------------------------------------------
+
+def test_prefetch_to_device_matches_plain_iteration():
+    loader = _make_loader()
+    plain = [[np.asarray(t._value) for t in b] for b in loader]
+    fetched = list(loader.prefetch_to_device(2))
+    assert len(fetched) == len(plain)
+    for want, got in zip(plain, fetched):
+        assert len(got) == len(want)
+        for w, g in zip(want, got):
+            assert isinstance(g, paddle.Tensor)
+            assert isinstance(g._value, jax.Array)   # already device-put
+            np.testing.assert_array_equal(w, np.asarray(g._value))
+
+
+def test_prefetch_relays_producer_errors():
+    class Bad(paddle.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i >= 4:
+                raise ValueError('boom')
+            return np.zeros(3, np.float32)
+
+    loader = paddle.io.DataLoader(Bad(), batch_size=2, shuffle=False)
+    it = loader.prefetch_to_device(2)
+    # the retry wrapper re-raises as RetryError, exactly like plain
+    # iteration would — the background thread must not swallow it
+    with pytest.raises(Exception, match='boom'):
+        list(it)
+
+
+def test_prefetch_early_close_stops_producer():
+    loader = _make_loader(batch_size=4)
+    it = loader.prefetch_to_device(2)
+    next(it)
+    it.close()                                 # must not hang or leak
+
+
+# ---- gradient merge under the async executor -----------------------------
+
+def test_grad_accum_matches_large_batch():
+    xs, ys = _data(16, seed=3)
+
+    paddle.seed(0)
+    big = _make_model(lr=1e-2)
+    big.train_batch([xs], [ys])
+
+    paddle.seed(0)
+    acc = _make_model(lr=1e-2)
+    acc.train_batch([xs[:8]], [ys[:8]], update=False)
+    acc.train_batch([xs[8:]], [ys[8:]], update=True)
+    acc._drain_inflight()
+
+    big._sync_train_state()
+    acc._sync_train_state()
+    got = {n: np.asarray(p._value)
+           for n, p in acc.network.named_parameters()}
+    want = {n: np.asarray(p._value)
+            for n, p in big.network.named_parameters()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
+# ---- persistence ---------------------------------------------------------
+
+def test_save_load_roundtrip_after_async_fit(tmp_path):
+    path = str(tmp_path / 'ckpt')
+    paddle.seed(0)
+    model = _make_model()
+    model.fit(_make_loader(), epochs=1, verbose=0)
+    model.save(path)
+
+    paddle.seed(1)
+    other = _make_model()
+    other.load(path)
+    assert other._opt_restored
+    got = other.network.state_dict()
+    want = model.network.state_dict()
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]._value),
+                                      np.asarray(want[k]._value), err_msg=k)
+    xs, ys = _data()
+    loss = other.train_batch([xs[:8]], [ys[:8]])   # restored state trains
+    assert np.isfinite(float(np.asarray(loss[0])))
+
+
+# ---- step timer ----------------------------------------------------------
+
+def test_step_timer_breakdown():
+    from paddle_tpu.profiler import StepTimer
+    paddle.seed(0)
+    model = _make_model()
+    model._step_timer = StepTimer()
+    model.fit(_make_loader(), epochs=1, verbose=0)
+    s = model._step_timer.summary()
+    assert s['steps'] == 4
+    assert s['steps_per_sec'] > 0
+    assert s['dispatch_ms_mean'] > 0
+    assert s['data_ms_mean'] > 0

@@ -1,0 +1,30 @@
+"""Smoke test for tools/perf_check.py (subprocess, CPU-safe)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_perf_check_emits_json_and_async_overhead_is_lower():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'perf_check.py'),
+         '--steps', '80'],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    data = json.loads(line)               # exactly one parsable JSON line
+    for key in ('steps_per_sec_async', 'steps_per_sec_sync',
+                'raw_jit_ms_per_step', 'host_overhead_ms_async',
+                'host_overhead_ms_sync'):
+        assert key in data and data[key] >= 0, key
+    assert data['steps_per_sec_async'] > 0
+    # the async executor strips the per-step write-back + blocking readback;
+    # generous margin (1.25x) keeps CI timing noise from flaking this
+    assert (data['host_overhead_ms_async']
+            < data['host_overhead_ms_sync'] * 1.25)

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""CPU-safe microbenchmark for the hapi async train executor.
+
+Times the SAME tiny-MLP fit loop three ways and prints ONE json line:
+
+  - ``async``: the default executor — device-resident train state, buffer
+    donation, deferred loss readback.
+  - ``sync``:  the ``PADDLE_TPU_SYNC_EXECUTOR=1`` legacy path — per-step
+    param-dict rebuild, write-back, and blocking loss readback.
+  - ``raw``:   the compiled step called directly in a python loop (the
+    jit floor — no Model bookkeeping at all).
+
+``host_overhead_ms_*`` is wall-per-step minus the raw-jit floor, i.e. the
+python tax the executor adds on top of the compiled step. The async number
+should sit well below the sync one; CI smoke-checks that claim without
+needing a TPU (tests/test_perf_check.py).
+
+Usage: python tools/perf_check.py [--steps N] [--batch B]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _make_model(paddle):
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    return model
+
+
+def _batches(steps, batch):
+    rng = np.random.RandomState(0)
+    xs = rng.rand(steps, batch, 32).astype('float32')
+    ys = rng.randint(0, 8, size=(steps, batch)).astype('int64')
+    return xs, ys
+
+
+def _time_fit_loop(model, xs, ys, warmup=3):
+    steps = xs.shape[0]
+    for i in range(warmup):           # compile + state capture
+        model.train_batch([xs[i]], [ys[i]])
+    t0 = time.perf_counter()
+    for i in range(warmup, steps):
+        model.train_batch([xs[i]], [ys[i]])
+    model._drain_inflight()
+    model._sync_train_state()
+    # fence: a host read of one param covers the whole dependency chain
+    np.asarray(next(iter(model.network.parameters()))._value).ravel()[0]
+    return (time.perf_counter() - t0) / (steps - warmup)
+
+
+def _time_raw_jit(model, xs, ys, warmup=3):
+    """The floor: drive the already-compiled step directly (donation-safe
+    chaining of params/buffers/opt_state through the loop)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.tensor.random import next_key
+
+    ts = model._ensure_tstate()
+    step = model._train_step
+    params, buffers, opt_state = ts.params, ts.buffers, ts.opt_state
+    lr = model._lr_scalar()
+    steps = xs.shape[0]
+    dev_x = [jax.device_put(xs[i]) for i in range(steps)]
+    dev_y = [jax.device_put(ys[i]) for i in range(steps)]
+    loss, _, params, buffers, opt_state = step(
+        params, buffers, opt_state, next_key(), lr, (dev_x[0],), (dev_y[0],))
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(1, steps):
+        loss, _, params, buffers, opt_state = step(
+            params, buffers, opt_state, next_key(), lr,
+            (dev_x[i],), (dev_y[i],))
+    loss.block_until_ready()
+    jnp.zeros(()).block_until_ready()
+    dt = (time.perf_counter() - t0) / (steps - 1)
+    # hand the chained state back so the model object stays consistent
+    ts.params, ts.buffers, ts.opt_state = params, buffers, opt_state
+    ts.refs_dirty = True
+    return dt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=60)
+    ap.add_argument('--batch', type=int, default=16)
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+
+    xs, ys = _batches(args.steps, args.batch)
+
+    m_async = _make_model(paddle)
+    m_async._async = True
+    wall_async = _time_fit_loop(m_async, xs, ys)
+    raw = _time_raw_jit(m_async, xs, ys)
+
+    m_sync = _make_model(paddle)
+    m_sync._async = False
+    wall_sync = _time_fit_loop(m_sync, xs, ys)
+
+    out = {
+        'steps': args.steps,
+        'batch': args.batch,
+        'steps_per_sec_async': round(1.0 / wall_async, 1),
+        'steps_per_sec_sync': round(1.0 / wall_sync, 1),
+        'raw_jit_ms_per_step': round(1e3 * raw, 4),
+        'host_overhead_ms_async': round(1e3 * max(wall_async - raw, 0.0), 4),
+        'host_overhead_ms_sync': round(1e3 * max(wall_sync - raw, 0.0), 4),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
