@@ -389,6 +389,19 @@ def _child_predictor():
     print(json.dumps(res))
 
 
+def _child_serving():
+    """Dynamic-batching serving row: requests/sec of the serving engine vs
+    per-request Predictor.run on a mixed 1-17 batch-size stream (the
+    tools/serve_bench.py measurement, subprocess-bounded like every other
+    stage)."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import serve_bench
+    print(json.dumps(serve_bench.run_bench(requests=160)))
+
+
 def _child_smoke():
     """30s pallas compile-smoke: compile+run the flash fwd AND bwd kernels on
     a tiny shape with a host-read fence. Run by the tunnel watcher on relay
@@ -720,6 +733,17 @@ def main(fast=False):
         else:
             print(f'predictor bench failed: {pnote}', file=sys.stderr)
 
+        srv, snote = _run_child(['--child-serving'], PREDICTOR_TIMEOUT_S)
+        if srv is not None:
+            out['serving_rps'] = srv['rps_engine']
+            out['serving_speedup_vs_per_request'] = srv['speedup']
+            out['serving_p99_ms'] = srv['latency_ms_p99']
+            out['serving_pad_waste_pct'] = srv['pad_waste_pct']
+            out['serving_compiles'] = srv['compiles_engine']
+            out['serving_compiles_ok'] = srv['compiles_ok']
+        else:
+            print(f'serving bench failed: {snote}', file=sys.stderr)
+
         eager, enote = _run_child(['--child-eager'], 180)
         if eager is not None:
             out['eager_ops_per_sec'] = round(eager['eager_ops_per_sec'], 1)
@@ -795,6 +819,8 @@ if __name__ == '__main__':
         _child_eager()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-decode':
         _child_decode()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-serving':
+        _child_serving()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':
         _child_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == '--smoke':

@@ -74,6 +74,7 @@ def __getattr__(name):
     if name in ('distributed', 'vision', 'text', 'distribution', 'inference',
                 'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler',
                 'hub', 'onnx', 'parallel', 'fluid', 'dataset', 'reader',
-                'sparsity', 'quantization', 'cost_model', 'fault'):
+                'sparsity', 'quantization', 'cost_model', 'fault',
+                'serving'):
         return importlib.import_module(f'.{name}', __name__)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

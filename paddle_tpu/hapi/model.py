@@ -75,6 +75,7 @@ class Model:
         self._inflight = collections.deque()
         self._scale_cache = None
         self._step_timer = None
+        self._engine = None
         self._async = os.environ.get('PADDLE_TPU_SYNC_EXECUTOR') != '1'
         try:
             self._inflight_window = max(
@@ -475,19 +476,27 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self._enter_mode(False)
-        mode_key = self._mode_sig()
-        step = self._eval_steps.get(mode_key)
+        inputs = [self._as_device(t) for t in _to_list(inputs)]
+        labels = [self._as_device(t) for t in _to_list(labels)]
+        # cache keyed on (mode, input signature) like the train path keys on
+        # mode: a predict stream with a ragged tail batch (or alternating
+        # labeled/unlabeled calls) selects its cached step by shape/dtype
+        # tree instead of churning one entry
+        key = (self._mode_sig(),
+               tuple((tuple(getattr(a, 'shape', ())),
+                      str(getattr(a, 'dtype', ''))) for a in inputs),
+               tuple((tuple(getattr(a, 'shape', ())),
+                      str(getattr(a, 'dtype', ''))) for a in labels))
+        step = self._eval_steps.get(key)
         if step is None:
             step = self._build_eval_step()
-            self._eval_steps[mode_key] = step
+            self._eval_steps[key] = step
         self._eval_step = step
         if self._tstate is not None:
             ts = self._ensure_tstate()
             params, buffers = ts.params, ts.buffers
         else:
             params, buffers = self._params_dict(), self._buffers_dict()
-        inputs = [self._as_device(t) for t in _to_list(inputs)]
-        labels = [self._as_device(t) for t in _to_list(labels)]
         loss, out = step(params, buffers, next_key(),
                          tuple(inputs), tuple(labels))
         return ([np.asarray(loss)] if loss is not None else None,
@@ -675,17 +684,71 @@ class Model:
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
-                verbose=1, callbacks=None):
+                verbose=1, callbacks=None, bucket_pad=True, engine=None):
+        """Run inference over ``test_data``.
+
+        ``bucket_pad`` (default on) pads a ragged tail batch up to the
+        nominal batch size (repeating the last row) and slices the outputs
+        back, so the whole loader is served by ONE compiled eval step
+        instead of retracing for the leftover batch. ``engine`` routes the
+        batches through a ``serving.InferenceEngine`` instead: pass an
+        engine instance, or ``True`` to use ``self.serving_engine()``.
+        Outputs stay on device until the end — no per-batch host round-trip
+        — so dispatch overlaps the next batch's collation.
+        """
         loader = self._as_loader(test_data, batch_size, False)
-        outputs = []
-        for batch in loader:
-            inputs, _ = self._split_batch(batch)
-            outputs.append(self.predict_batch(inputs))
+        if engine is not None:
+            eng = self.serving_engine() if engine is True else engine
+            futs = []
+            for batch in loader:
+                inputs, _ = self._split_batch(batch)
+                futs.append(eng.submit(*[np.asarray(i) for i in inputs]))
+            outputs = []
+            for f in futs:
+                res = f.result()
+                outputs.append(res if isinstance(res, list) else [res])
+        else:
+            device_outs = []
+            nominal = None
+            for batch in loader:
+                inputs, _ = self._split_batch(batch)
+                first = inputs[0] if inputs else None
+                n = (first.shape[0]
+                     if getattr(first, 'ndim', 0) >= 1 else None)
+                if nominal is None:
+                    nominal = n
+                padded = (bucket_pad and n is not None and nominal is not None
+                          and n < nominal)
+                if padded:
+                    pad = nominal - n
+                    inputs = [jnp.concatenate(
+                        [x, jnp.repeat(x[-1:], pad, axis=0)], axis=0)
+                        if getattr(x, 'ndim', 0) >= 1 and x.shape[0] == n
+                        else x for x in inputs]
+                _, out = self.eval_batch(inputs, [])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                if padded:
+                    outs = [o[:n] if (getattr(o, 'ndim', 0) >= 1
+                                      and o.shape[0] == nominal) else o
+                            for o in outs]
+                device_outs.append(outs)
+            # single host materialization point: device work for every batch
+            # was already dispatched asynchronously above
+            outputs = [[np.asarray(o) for o in outs] for outs in device_outs]
         n_out = len(outputs[0])
         grouped = [[o[i] for o in outputs] for i in range(n_out)]
         if stack_outputs:
             grouped = [np.concatenate(g, axis=0) for g in grouped]
         return grouped
+
+    def serving_engine(self, **kwargs):
+        """Lazily build (and cache) a ``serving.InferenceEngine`` over this
+        model's network — the dynamic-batching path for online traffic
+        (``Model.predict(..., engine=True)`` routes through it)."""
+        if self._engine is None:
+            from ..serving import InferenceEngine
+            self._engine = InferenceEngine(self, **kwargs)
+        return self._engine
 
     # ---- persistence -----------------------------------------------------
     def save(self, path, training=True):

@@ -51,6 +51,14 @@ class Config:
     def enable_memory_optim(self):
         self._enable_memory_optim = True
 
+    def switch_batch_dim_dynamic(self, flag=True):
+        """Serve variable batch sizes through bucketed executables: inputs
+        are padded up to the next power-of-two batch and outputs sliced
+        back, so N distinct request sizes cost O(log N) compiles instead of
+        one executable each (see paddle_tpu.serving for the full dynamic
+        batcher this feeds)."""
+        self._batch_dim_dynamic = bool(flag)
+
     def set_precision(self, precision):
         self._precision = precision
 
@@ -105,7 +113,8 @@ class Predictor:
         self._results = {}
         self._layer = None
         self._compiled = {}
-        self._output_names = ['out0']
+        self._trace_count = 0     # trace-time side effect (tests assert
+        self._output_names = ['out0']   # one compile per bucket)
 
     def attach_layer(self, layer):
         """Bind the Layer class instance whose forward defines the program.
@@ -156,6 +165,7 @@ class Predictor:
                 buffers = lower_tree(buffers)
 
             def infer(*xs):
+                self._trace_count += 1
                 if low is not None:
                     # inputs must match the lowered param dtype (convs and
                     # matmuls require homogeneous operand dtypes)
@@ -173,6 +183,7 @@ class Predictor:
             feed = [jnp.asarray(np.asarray(x)) for x in inputs]
         else:
             feed = [jnp.asarray(self._feed[n]) for n in self._input_names]
+        n_rows = None    # set when dynamic batching padded the feed
         if self._layer is None:
             if self._exec is None:
                 raise RuntimeError(
@@ -196,10 +207,30 @@ class Predictor:
                             f'attach_layer(model) for dynamic shapes.')
             out = self._exec.call(self._params, self._buffers, *feed)
         else:
+            if self.config._batch_dim_dynamic and feed and \
+                    getattr(feed[0], 'ndim', 0) >= 1:
+                # bucketed dynamic batching: pad every input whose leading
+                # dim matches the batch up to the next power-of-two bucket,
+                # run the per-bucket cached executable, slice outputs back.
+                # N distinct request sizes -> O(log N) compiles.
+                from ..serving.bucketing import bucket_for
+                n_rows = feed[0].shape[0]
+                bucket = bucket_for(n_rows)
+                if bucket != n_rows:
+                    feed = [jnp.concatenate(
+                        [f, jnp.repeat(f[-1:], bucket - n_rows, axis=0)],
+                        axis=0)
+                        if getattr(f, 'ndim', 0) >= 1
+                        and f.shape[0] == n_rows else f
+                        for f in feed]
             key = tuple((tuple(f.shape), str(f.dtype)) for f in feed)
             out = self._get_compiled(key)(*feed)
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs = [np.asarray(o) for o in outs]
+        if n_rows is not None and self._layer is not None:
+            outs = [o[:n_rows] if (getattr(o, 'ndim', 0) >= 1
+                                   and o.shape[0] != n_rows) else o
+                    for o in outs]
         self._output_names = [f'out{i}' for i in range(len(outs))]
         self._results = dict(zip(self._output_names, outs))
         return outs

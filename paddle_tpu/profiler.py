@@ -132,6 +132,16 @@ class ProfilerOptions:
         return self._options[name]
 
 
+def percentile(samples, q):
+    """Nearest-rank percentile of an (unsorted) sample sequence; q in
+    [0, 100]. Shared by StepTimer and the serving metrics so every latency
+    number in the framework is computed the same way."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * q / 100.0))]
+
+
 class StepTimer:
     """Per-step host-side timing breakdown for the async train executor.
 
@@ -190,11 +200,9 @@ class StepTimer:
         for p, xs in self._samples.items():
             if not xs:
                 continue
-            s = sorted(xs)
             out[p + '_ms_mean'] = 1e3 * sum(xs) / len(xs)
-            out[p + '_ms_p50'] = 1e3 * s[len(s) // 2]
-            out[p + '_ms_p99'] = 1e3 * s[min(len(s) - 1,
-                                             int(len(s) * 0.99))]
+            out[p + '_ms_p50'] = 1e3 * percentile(xs, 50)
+            out[p + '_ms_p99'] = 1e3 * percentile(xs, 99)
         return out
 
 

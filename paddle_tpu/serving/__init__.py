@@ -1,0 +1,33 @@
+"""paddle_tpu.serving — dynamic-batching inference for heavy traffic.
+
+The ROADMAP's serving path: an ``InferenceEngine`` in front of a bucketed
+compile cache. Requests of uneven size (``submit()`` returns a future) are
+coalesced on a background dispatch thread into padded power-of-two buckets,
+so the whole request stream is served by at most
+``ceil(log2(max_batch)) + 1`` XLA executables per input signature — the
+Ragged-Paged-Attention / TPP serving discipline.
+
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine(net, max_batch_size=16, max_delay_ms=2)
+    fut = engine.submit(x)            # x: [n, ...], n >= 1
+    y = fut.result(timeout=1.0)
+    print(engine.stats())             # p50/p99, pad waste, occupancy, ...
+    engine.shutdown()
+
+Robustness: bounded admission queue (``QueueFullError``), per-request
+deadlines (``DeadlineExceededError``, a fault.RetryError), a CircuitBreaker
+around the device call, and the ``serving.dispatch`` chaos point.
+"""
+from .bucketing import (bucket_for, bucket_sizes, input_signature,  # noqa: F401
+                        pad_rows)
+from .bucket_cache import BucketCompileCache  # noqa: F401
+from .errors import (DeadlineExceededError, EngineClosedError,  # noqa: F401
+                     QueueFullError)
+from .metrics import ServingStats  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401
+
+__all__ = [
+    'InferenceEngine', 'ServingStats', 'BucketCompileCache',
+    'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',
+    'QueueFullError', 'DeadlineExceededError', 'EngineClosedError',
+]

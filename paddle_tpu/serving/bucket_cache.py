@@ -1,0 +1,40 @@
+"""Bucketed executable cache.
+
+One compiled function per ``(bucket_size, input_signature, precision)`` —
+the TPP (arxiv 2104.05755) discipline of a small set of shape-stable
+compiled primitives reused across the whole request stream. The builder is
+supplied by the engine; the cache only owns keying and lifetime. Since
+every cached function is invoked at exactly one padded shape, ``len(cache)``
+IS the executable count the serve benchmark asserts on.
+"""
+import threading
+
+
+class BucketCompileCache:
+    def __init__(self, builder):
+        self._builder = builder
+        self._fns = {}
+        self._lock = threading.RLock()
+        self.misses = 0
+
+    def get(self, bucket, sig, precision):
+        key = (bucket, sig, precision)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = self._builder(bucket, sig, precision)
+                self._fns[key] = fn
+                self.misses += 1
+        return fn
+
+    def __len__(self):
+        with self._lock:
+            return len(self._fns)
+
+    def keys(self):
+        with self._lock:
+            return list(self._fns)
+
+    def clear(self):
+        with self._lock:
+            self._fns.clear()

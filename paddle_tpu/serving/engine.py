@@ -1,0 +1,333 @@
+"""InferenceEngine: dynamic-batching serving front-end for a compiled model.
+
+``submit()`` returns a future immediately; a background dispatch thread
+coalesces same-signature requests into power-of-two padded buckets
+(``bucketing``), executes them through a ``BucketCompileCache`` (one XLA
+executable per (bucket, signature, precision) — steady-state traffic never
+retraces), and slices each request's rows back out of the batched output.
+
+Robustness is built from the PR-1 fault primitives:
+ - bounded queue with explicit backpressure (``QueueFullError``),
+ - per-request deadlines (``DeadlineExceededError`` — a RetryError),
+ - a ``fault.CircuitBreaker`` around the device call,
+ - a ``serving.dispatch`` fault-injection point for the chaos harness.
+
+Observability: every admission/flush/latency event lands in
+``ServingStats``; ``engine.stats()`` is the one-stop snapshot.
+
+Env knobs: ``PADDLE_TPU_SERVE_MAX_BATCH`` (default 16),
+``PADDLE_TPU_SERVE_MAX_DELAY_MS`` (default 2.0).
+"""
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fault
+from .batcher import (PendingQueues, Request, SplitJoin, normalize_request)
+from .bucket_cache import BucketCompileCache
+from .bucketing import bucket_for, bucket_sizes, pad_rows
+from .errors import DeadlineExceededError, EngineClosedError, QueueFullError
+from .metrics import ServingStats
+
+ENV_MAX_BATCH = 'PADDLE_TPU_SERVE_MAX_BATCH'
+ENV_MAX_DELAY = 'PADDLE_TPU_SERVE_MAX_DELAY_MS'
+
+_LOW_DTYPES = {'bfloat16': jnp.bfloat16, 'float16': jnp.float16}
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _resolve_backend(net, precision):
+    """Accepts a Layer, a hapi Model, or an inference Predictor and returns
+    (layer, params, buffers, precision)."""
+    from ..nn.layer_base import Layer, buffer_arrays, param_arrays
+    if not isinstance(net, Layer) and \
+            isinstance(getattr(net, 'network', None), Layer):
+        # hapi Model: flush the async executor's device-resident state back
+        # into the Layer tree before we freeze a serving copy of it
+        net._drain_inflight()
+        net._sync_train_state()
+        net = net.network
+    if isinstance(net, Layer):
+        return (net, param_arrays(net), buffer_arrays(net),
+                precision or 'float32')
+    if hasattr(net, 'attach_layer') and hasattr(net, 'config'):
+        # inference.Predictor
+        pred = net
+        layer = pred._layer
+        if layer is None:
+            raise ValueError(
+                'Predictor has no attached Layer; the serving engine batches '
+                'through a re-jittable forward — call attach_layer(model) '
+                '(the exported .pdexec program has pinned shapes)')
+        if precision is None:
+            precision = pred.config._precision
+            stored = pred._meta.get('precision')
+            if precision == 'float32' and stored in _LOW_DTYPES:
+                precision = stored   # offline-converted model: honor it
+        params = {k: jnp.asarray(v) for k, v in pred._params.items()}
+        buffers = {k: jnp.asarray(v) for k, v in pred._buffers.items()}
+        return layer, params, buffers, precision or 'float32'
+    raise TypeError(f'cannot serve a {type(net).__name__}; expected a '
+                    f'Layer, hapi Model, or inference Predictor')
+
+
+class InferenceEngine:
+    """Dynamic-batching inference engine over one model.
+
+    ``submit(*inputs)`` takes one request — every input batch-major with a
+    shared leading row count (1 row is the single-query case; oversized
+    requests are split across buckets transparently). Returns a
+    ``concurrent.futures.Future`` resolving to the sliced outputs (a single
+    array, or a list when the model has several outputs).
+    """
+
+    def __init__(self, net=None, *, max_batch_size=None, max_delay_ms=None,
+                 queue_capacity=256, precision=None, default_deadline_ms=None,
+                 breaker=None, autostart=True, clock=None):
+        layer, params, buffers, precision = _resolve_backend(net, precision)
+        layer.eval()    # serving is per-sample: BN/dropout must be frozen
+        self._layer = layer
+        self._precision = precision
+        low = _LOW_DTYPES.get(precision)
+        self._low = low
+
+        def lower(tree):
+            if low is None:
+                return tree
+            # buffers too: an f32 BN running stat would re-promote
+            # activations back to f32 mid-network (same rule as Predictor)
+            return {k: (v.astype(low)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in tree.items()}
+        self._params = lower(params)
+        self._buffers = lower(buffers)
+
+        self.max_batch_size = int(max_batch_size if max_batch_size is not None
+                                  else _env_int(ENV_MAX_BATCH, 16))
+        delay_ms = (max_delay_ms if max_delay_ms is not None
+                    else _env_float(ENV_MAX_DELAY, 2.0))
+        self.max_delay_s = max(0.0, float(delay_ms) / 1e3)
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self._breaker = breaker if breaker is not None else \
+            fault.CircuitBreaker(failure_threshold=5, recovery_timeout=5.0)
+        self._clock = clock or time.monotonic
+        self._autostart = autostart
+
+        self._cache = BucketCompileCache(self._build)
+        self._trace_count = 0        # trace-time side effect: retraces show
+        self._stats = ServingStats(clock=self._clock)
+        self._queues = PendingQueues()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._thread = None
+        self._closed = False
+        self._draining = False
+
+    # ---- compile path ----------------------------------------------------
+    def _build(self, bucket, sig, precision):
+        """One jitted forward per cache key. Params/buffers are traced
+        arguments (shared device residency across every bucket), not
+        closed-over constants — six buckets must not mean six HBM copies of
+        the weights."""
+        from ..nn.layer_base import functional_call
+        layer, low = self._layer, self._low
+
+        def infer(params, buffers, *xs):
+            self._trace_count += 1
+            if low is not None:
+                xs = [x.astype(low)
+                      if jnp.issubdtype(x.dtype, jnp.floating) else x
+                      for x in xs]
+            out, _ = functional_call(layer, params, buffers, *xs)
+            return out
+        return jax.jit(infer)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError('engine already shut down')
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop,
+                    name='paddle-tpu-serving-dispatch', daemon=True)
+                self._thread.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the dispatch thread. ``drain=True`` executes everything
+        already admitted first; otherwise pending futures fail with
+        EngineClosedError."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = drain
+            failed = [] if drain else self._queues.drain_all()
+            self._cv.notify_all()
+        for r in failed:
+            r.future.set_exception(EngineClosedError('engine shut down'))
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # ---- admission -------------------------------------------------------
+    def submit(self, *inputs, deadline_ms=None):
+        arrays, n, sig = normalize_request(inputs)
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else self.default_deadline_ms)
+        now = self._clock()
+        deadline_t = (now + deadline_ms / 1e3
+                      if deadline_ms is not None else None)
+        future = Future()
+        max_b = self.max_batch_size
+        if n <= max_b:
+            chunks = [(arrays, future)]
+        else:
+            # split an oversized request into bucket-sized chunks joined
+            # back into the caller's single future
+            bounds = list(range(0, n, max_b)) + [n]
+            join = SplitJoin(future, len(bounds) - 1)
+            chunks = [([a[lo:hi] for a in arrays], join.part(i))
+                      for i, (lo, hi) in enumerate(zip(bounds, bounds[1:]))]
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError('engine already shut down')
+            depth = self._queues.depth
+            if depth + len(chunks) > self.queue_capacity:
+                self._stats.note_rejected()
+                raise QueueFullError(self.queue_capacity, depth)
+            for arrs, fut in chunks:
+                self._queues.push(Request(arrs, sig, fut, now, deadline_t))
+            # split requests are accounted per admitted chunk so submitted/
+            # completed/occupancy all measure the same unit of work
+            self._stats.note_submitted(len(chunks))
+            if len(chunks) > 1:
+                self._stats.note_split()
+            self._cv.notify_all()
+        if self._autostart and self._thread is None:
+            self.start()
+        return future
+
+    # ---- dispatch --------------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            group = None
+            with self._cv:
+                while True:
+                    now = self._clock()
+                    force = self._closed
+                    group = self._queues.take_ready(
+                        now, self.max_batch_size, self.max_delay_s,
+                        force=force)
+                    if group is not None:
+                        break
+                    if self._closed:
+                        return
+                    wait = self._queues.time_until_ready(now,
+                                                         self.max_delay_s)
+                    # a fake test clock never advances real time: cap the
+                    # sleep so aged groups are still noticed promptly
+                    self._cv.wait(wait if wait is None
+                                  else min(max(wait, 1e-4), 0.05))
+            try:
+                self._execute(*group)
+            except BaseException as e:     # never kill the dispatch thread
+                for r in group[1]:
+                    if not _future_done(r.future):
+                        r.future.set_exception(e)
+                self._stats.note_failed(len(group[1]))
+
+    def _execute(self, sig, reqs):
+        now = self._clock()
+        live = []
+        for r in reqs:
+            if r.deadline_t is not None and now > r.deadline_t:
+                waited = (now - r.enqueue_t) * 1e3
+                limit = (r.deadline_t - r.enqueue_t) * 1e3
+                r.future.set_exception(DeadlineExceededError(waited, limit))
+                self._stats.note_expired()
+            else:
+                live.append(r)
+                self._stats.note_queue_wait(now - r.enqueue_t)
+        if not live:
+            return
+        rows = sum(r.n for r in live)
+        bucket = bucket_for(rows, self.max_batch_size)
+        n_in = len(live[0].arrays)
+        cols = [np.concatenate([r.arrays[i] for r in live], axis=0)
+                if len(live) > 1 else live[0].arrays[i]
+                for i in range(n_in)]
+        padded = [pad_rows(c, bucket) for c in cols]
+        t0 = time.perf_counter()
+
+        def device_call():
+            fault.inject('serving.dispatch')
+            fn = self._cache.get(bucket, sig, self._precision)
+            out = fn(self._params, self._buffers, *padded)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            # ONE host readback for the whole batch, then host-side slicing
+            return [np.asarray(o) for o in outs]
+
+        try:
+            outs = self._breaker.call(device_call)
+        except Exception as e:
+            for r in live:
+                r.future.set_exception(e)
+            self._stats.note_failed(len(live))
+            return
+        exec_s = time.perf_counter() - t0
+        done_t = self._clock()
+        off = 0
+        for r in live:
+            res = [o[off:off + r.n] if (getattr(o, 'ndim', 0) >= 1
+                                        and o.shape[0] == bucket) else o
+                   for o in outs]
+            off += r.n
+            r.future.set_result(res[0] if len(res) == 1 else res)
+            self._stats.note_completed(done_t - r.enqueue_t)
+        self._stats.note_batch(rows=rows, bucket=bucket, exec_s=exec_s)
+
+    # ---- observability ---------------------------------------------------
+    def stats(self):
+        out = self._stats.snapshot()
+        with self._lock:
+            out['queue_depth'] = self._queues.depth
+        out['compiles'] = len(self._cache)
+        out['traces'] = self._trace_count
+        out['buckets'] = list(bucket_sizes(self.max_batch_size))
+        out['max_batch_size'] = self.max_batch_size
+        out['max_delay_ms'] = self.max_delay_s * 1e3
+        out['precision'] = self._precision
+        out['circuit_state'] = self._breaker.state
+        return out
+
+
+def _future_done(fut):
+    done = getattr(fut, 'done', None)
+    return done() if callable(done) else False

@@ -1,0 +1,37 @@
+"""Typed serving errors.
+
+``DeadlineExceededError`` subclasses ``fault.RetryError`` so callers that
+already classify RetryError-family timeouts (the PR-1 fault-tolerance
+contract) handle an expired serving request with the same code path.
+"""
+from ..fault.errors import RetryError
+
+
+class QueueFullError(RuntimeError):
+    """Admission control rejected a request: the engine's bounded queue is
+    at capacity. Explicit backpressure — the caller decides whether to shed,
+    retry with backoff, or block; the engine never buffers unboundedly."""
+
+    def __init__(self, capacity, depth):
+        super().__init__(
+            f'serving queue full ({depth}/{capacity} pending); '
+            f'request rejected by admission control')
+        self.capacity = capacity
+        self.depth = depth
+
+
+class DeadlineExceededError(RetryError):
+    """A request's deadline expired while it waited in the batching queue;
+    it was dropped without touching the device."""
+
+    def __init__(self, waited_ms, deadline_ms):
+        RuntimeError.__init__(
+            self, f'request deadline {deadline_ms:.1f}ms exceeded after '
+            f'{waited_ms:.1f}ms in queue')
+        self.attempts = 0
+        self.waited_ms = waited_ms
+        self.deadline_ms = deadline_ms
+
+
+class EngineClosedError(RuntimeError):
+    """submit() after shutdown(): the dispatch thread is gone."""

@@ -1,0 +1,117 @@
+"""Serving telemetry: per-request and per-batch counters behind one lock.
+
+Extends the PR-2 profiler instrumentation (StepTimer's phase breakdown for
+training) to the serving side: queue wait, execution time, end-to-end
+latency, batch occupancy / pad waste, and admission-control outcomes.
+Percentiles come from ``profiler.percentile`` so training and serving
+report latency identically. Sample windows are bounded deques — a
+long-lived engine never grows its telemetry without bound.
+"""
+import collections
+import threading
+import time
+
+from ..profiler import percentile
+
+WINDOW = 4096
+
+
+class ServingStats:
+    """Thread-safe accumulator; ``snapshot()`` is the ``engine.stats()``
+    payload (schema documented in the README Serving section)."""
+
+    def __init__(self, clock=None):
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._start_t = self._clock()
+            self._submitted = 0
+            self._completed = 0
+            self._rejected = 0
+            self._expired = 0
+            self._failed = 0
+            self._split = 0
+            self._batches = 0
+            self._rows = 0
+            self._bucket_rows = 0
+            self._queue_wait_s = collections.deque(maxlen=WINDOW)
+            self._latency_s = collections.deque(maxlen=WINDOW)
+            self._exec_s = collections.deque(maxlen=WINDOW)
+            self._batch_sizes = collections.deque(maxlen=WINDOW)
+
+    # ---- recording (engine-internal) ------------------------------------
+    def note_submitted(self, n=1):
+        with self._lock:
+            self._submitted += n
+
+    def note_split(self):
+        with self._lock:
+            self._split += 1
+
+    def note_rejected(self):
+        with self._lock:
+            self._rejected += 1
+
+    def note_expired(self):
+        with self._lock:
+            self._expired += 1
+
+    def note_queue_wait(self, seconds):
+        with self._lock:
+            self._queue_wait_s.append(seconds)
+
+    def note_completed(self, latency_s):
+        with self._lock:
+            self._completed += 1
+            self._latency_s.append(latency_s)
+
+    def note_failed(self, n=1):
+        with self._lock:
+            self._failed += n
+
+    def note_batch(self, rows, bucket, exec_s):
+        with self._lock:
+            self._batches += 1
+            self._rows += rows
+            self._bucket_rows += bucket
+            self._exec_s.append(exec_s)
+            self._batch_sizes.append(rows)
+
+    # ---- reading ---------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            elapsed = max(self._clock() - self._start_t, 1e-9)
+            occ = (self._rows / self._bucket_rows
+                   if self._bucket_rows else 0.0)
+            return {
+                'submitted': self._submitted,
+                'completed': self._completed,
+                'rejected': self._rejected,
+                'expired': self._expired,
+                'failed': self._failed,
+                'split_requests': self._split,
+                'batches': self._batches,
+                'rows': self._rows,
+                'padded_rows': self._bucket_rows,
+                'batch_occupancy': round(occ, 4),
+                'pad_waste_pct': round(100.0 * (1.0 - occ), 2)
+                if self._bucket_rows else 0.0,
+                'avg_batch_size': round(
+                    sum(self._batch_sizes) / len(self._batch_sizes), 2)
+                if self._batch_sizes else 0.0,
+                'queue_wait_ms_p50': round(
+                    1e3 * percentile(self._queue_wait_s, 50), 3),
+                'queue_wait_ms_p99': round(
+                    1e3 * percentile(self._queue_wait_s, 99), 3),
+                'latency_ms_p50': round(
+                    1e3 * percentile(self._latency_s, 50), 3),
+                'latency_ms_p99': round(
+                    1e3 * percentile(self._latency_s, 99), 3),
+                'exec_ms_p50': round(1e3 * percentile(self._exec_s, 50), 3),
+                'exec_ms_p99': round(1e3 * percentile(self._exec_s, 99), 3),
+                'requests_per_sec': round(self._completed / elapsed, 2),
+                'uptime_s': round(elapsed, 3),
+            }

@@ -1,0 +1,351 @@
+"""paddle_tpu.serving — dynamic-batching engine, bucketed compile cache,
+admission control, deadlines, circuit breaker, and the Predictor/hapi
+bucketing satellites (ISSUE 3)."""
+import math
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault, nn, serving
+from paddle_tpu.fault import CircuitOpenError, InjectedFault, RetryError
+from paddle_tpu.serving import (DeadlineExceededError, EngineClosedError,
+                                InferenceEngine, QueueFullError, bucket_for,
+                                bucket_sizes, input_signature, pad_rows)
+
+pytestmark = pytest.mark.serving
+
+
+def _net():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    return net
+
+
+def _fwd(net, x):
+    return np.asarray(net(paddle.to_tensor(np.asarray(x))))
+
+
+# ---------------------------------------------------------------------------
+# bucket policy
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_and_selection():
+    assert bucket_sizes(16) == (1, 2, 4, 8, 16)
+    assert bucket_sizes(1) == (1,)
+    assert bucket_sizes(6) == (1, 2, 4, 6)      # non-pow2 terminal bucket
+    # the ladder is exactly ceil(log2(max)) + 1 executables
+    for mb in (1, 2, 8, 16, 64):
+        assert len(bucket_sizes(mb)) == int(math.ceil(math.log2(mb))) + 1
+    assert bucket_for(1, 16) == 1
+    assert bucket_for(3, 16) == 4
+    assert bucket_for(5, 16) == 8
+    assert bucket_for(16, 16) == 16
+    assert bucket_for(9) == 16                  # unbounded (Predictor path)
+    with pytest.raises(ValueError):
+        bucket_for(17, 16)
+    with pytest.raises(ValueError):
+        bucket_for(0, 16)
+
+
+def test_pad_rows_roundtrip_bit_exact():
+    x = np.random.rand(5, 3, 2).astype('float32')
+    padded = pad_rows(x, 8)
+    assert padded.shape == (8, 3, 2)
+    np.testing.assert_array_equal(padded[:5], x)      # real rows untouched
+    np.testing.assert_array_equal(padded[5], x[4])    # edge padding
+    assert pad_rows(x, 5) is not None and pad_rows(x, 5).shape[0] == 5
+    with pytest.raises(ValueError):
+        pad_rows(x, 4)
+
+
+def test_input_signature_groups_batchable_requests():
+    a = [np.zeros((3, 8), 'float32')]
+    b = [np.zeros((7, 8), 'float32')]
+    c = [np.zeros((3, 9), 'float32')]
+    assert input_signature(a) == input_signature(b)   # sizes batch together
+    assert input_signature(a) != input_signature(c)   # feature dims do not
+
+
+# ---------------------------------------------------------------------------
+# engine: correctness and compile discipline
+# ---------------------------------------------------------------------------
+
+def test_engine_outputs_match_direct_forward_mixed_sizes():
+    net = _net()
+    with InferenceEngine(net, max_batch_size=8, max_delay_ms=1.0) as eng:
+        xs = [np.random.rand(n, 8).astype('float32')
+              for n in (1, 3, 5, 8, 17)]          # 17 > max_batch: splits
+        futs = [eng.submit(x) for x in xs]
+        outs = [f.result(timeout=30) for f in futs]
+        st = eng.stats()
+        assert st['split_requests'] == 1
+        assert st['completed'] >= len(xs)
+    # direct forward on the shared Layer only after the engine is idle —
+    # tracing binds through the same module tree
+    for x, out in zip(xs, outs):
+        assert out.shape == (x.shape[0], 4)
+        np.testing.assert_allclose(out, _fwd(net, x), atol=1e-5)
+
+
+def test_engine_compile_count_one_trace_per_bucket():
+    net = _net()
+    with InferenceEngine(net, max_batch_size=16, max_delay_ms=0.5) as eng:
+        # warm every bucket, then hammer steady-state traffic
+        for n in (1, 2, 4, 8, 16):
+            eng.submit(np.random.rand(n, 8).astype('float32')).result(
+                timeout=30)
+        st = eng.stats()
+        assert st['compiles'] <= len(bucket_sizes(16)) == 5
+        warm = st['compiles']
+        assert st['traces'] == warm        # jit never silently retraced
+        futs = [eng.submit(np.random.rand(np.random.randint(1, 17), 8)
+                           .astype('float32')) for _ in range(40)]
+        for f in futs:
+            f.result(timeout=30)
+        st = eng.stats()
+        assert st['compiles'] == warm      # steady state: zero new traces
+        assert st['traces'] == warm
+
+
+def test_engine_multi_input_and_stats_schema():
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + self.fc(b)
+
+    net = TwoIn()
+    net.eval()
+    with InferenceEngine(net, max_batch_size=8, max_delay_ms=1.0) as eng:
+        a = np.random.rand(3, 8).astype('float32')
+        b = np.random.rand(3, 8).astype('float32')
+        out = eng.submit(a, b).result(timeout=30)
+        ref = np.asarray(net(paddle.to_tensor(a), paddle.to_tensor(b)))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        st = eng.stats()
+    for key in ('submitted', 'completed', 'rejected', 'expired', 'failed',
+                'batches', 'batch_occupancy', 'pad_waste_pct',
+                'queue_wait_ms_p50', 'queue_wait_ms_p99', 'latency_ms_p50',
+                'latency_ms_p99', 'requests_per_sec', 'compiles', 'buckets',
+                'queue_depth', 'circuit_state', 'max_batch_size'):
+        assert key in st, key
+    assert st['circuit_state'] == 'closed'
+    assert 0.0 <= st['batch_occupancy'] <= 1.0
+
+
+def test_engine_env_knobs(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_SERVE_MAX_BATCH', '8')
+    monkeypatch.setenv('PADDLE_TPU_SERVE_MAX_DELAY_MS', '7.5')
+    eng = InferenceEngine(_net(), autostart=False)
+    assert eng.max_batch_size == 8
+    assert eng.max_delay_s == pytest.approx(0.0075)
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission control, deadlines, shutdown
+# ---------------------------------------------------------------------------
+
+def test_backpressure_queue_full_rejects():
+    eng = InferenceEngine(_net(), max_batch_size=8, queue_capacity=2,
+                          autostart=False)     # dispatch never starts:
+    x = np.random.rand(2, 8).astype('float32')  # the queue must fill
+    f1, f2 = eng.submit(x), eng.submit(x)
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(x)
+    assert ei.value.capacity == 2
+    assert eng.stats()['rejected'] == 1
+    # draining shutdown still serves what was admitted
+    eng.start()
+    eng.shutdown(drain=True)
+    assert f1.result(timeout=30).shape == (2, 4)
+    assert f2.result(timeout=30).shape == (2, 4)
+
+
+def test_deadline_expiry_is_retryerror_family_not_a_hang():
+    eng = InferenceEngine(_net(), max_batch_size=8, max_delay_ms=1.0,
+                          autostart=False)
+    x = np.random.rand(2, 8).astype('float32')
+    fut = eng.submit(x, deadline_ms=0.0)        # expired on arrival
+    time.sleep(0.01)
+    eng.start()
+    with pytest.raises(DeadlineExceededError) as ei:
+        fut.result(timeout=30)                  # resolves promptly, no hang
+    assert isinstance(ei.value, RetryError)     # RetryError-family contract
+    assert eng.stats()['expired'] == 1
+    eng.shutdown()
+
+
+def test_default_deadline_applies_to_every_request():
+    eng = InferenceEngine(_net(), max_batch_size=8, max_delay_ms=1.0,
+                          default_deadline_ms=0.0, autostart=False)
+    fut = eng.submit(np.random.rand(1, 8).astype('float32'))
+    time.sleep(0.01)
+    eng.start()
+    with pytest.raises(DeadlineExceededError):
+        fut.result(timeout=30)
+    eng.shutdown()
+
+
+def test_submit_after_shutdown_and_no_drain_failfast():
+    eng = InferenceEngine(_net(), max_batch_size=8, autostart=False)
+    fut = eng.submit(np.random.rand(1, 8).astype('float32'))
+    eng.shutdown(drain=False)
+    with pytest.raises(EngineClosedError):
+        fut.result(timeout=30)
+    with pytest.raises(EngineClosedError):
+        eng.submit(np.random.rand(1, 8).astype('float32'))
+
+
+def test_submit_validates_requests():
+    eng = InferenceEngine(_net(), autostart=False)
+    with pytest.raises(ValueError):
+        eng.submit()                             # no inputs
+    with pytest.raises(ValueError):
+        eng.submit(np.float32(1.0))              # scalar
+    with pytest.raises(ValueError):
+        eng.submit(np.zeros((2, 8), 'f4'), np.zeros((3, 8), 'f4'))
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fault injection + circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_injected_dispatch_faults_open_the_circuit_then_recover():
+    fake = [1000.0]
+    breaker = fault.CircuitBreaker(failure_threshold=2, recovery_timeout=30.0,
+                                   clock=lambda: fake[0])
+    eng = InferenceEngine(_net(), max_batch_size=4, max_delay_ms=0.5,
+                          breaker=breaker)
+    x = np.random.rand(1, 8).astype('float32')
+    try:
+        fault.configure('serving.dispatch:1.0')
+        for _ in range(2):                       # threshold consecutive hits
+            with pytest.raises(InjectedFault):
+                eng.submit(x).result(timeout=30)
+        assert breaker.state == fault.OPEN
+        # open circuit: refused WITHOUT touching the device (inject still
+        # armed — an executed call would raise InjectedFault instead)
+        with pytest.raises(CircuitOpenError):
+            eng.submit(x).result(timeout=30)
+        assert eng.stats()['circuit_state'] == 'open'
+        fault.configure(None)                    # dependency "recovers"
+        fake[0] += 31.0                          # recovery timeout elapses
+        out = eng.submit(x).result(timeout=30)   # half-open trial succeeds
+        assert out.shape == (1, 4)
+        assert breaker.state == fault.CLOSED
+        assert eng.stats()['failed'] >= 2
+    finally:
+        fault.reload()                           # re-arm from (clean) env
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: inference.Predictor dynamic batch buckets
+# ---------------------------------------------------------------------------
+
+def _saved_predictor(tmp_path, dynamic):
+    from paddle_tpu.inference import Config, create_predictor
+    net = _net()
+    path = str(tmp_path / 'm')
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([-1, 8], 'float32')])
+    cfg = Config(path + '.pdmodel')
+    if dynamic:
+        cfg.switch_batch_dim_dynamic()
+    pred = create_predictor(cfg)
+    pred.attach_layer(_net())
+    return net, pred
+
+
+def test_predictor_dynamic_batch_buckets_and_slices(tmp_path):
+    net, pred = _saved_predictor(tmp_path, dynamic=True)
+    sizes = (1, 2, 3, 5, 7, 8, 9, 13, 16)
+    for n in sizes:
+        x = np.random.rand(n, 8).astype('float32')
+        out = pred.run([x])[0]
+        assert out.shape == (n, 4)               # outputs sliced back
+        np.testing.assert_allclose(out, _fwd(net, x), atol=1e-5)
+    # buckets {1,2,4,8,16} -> 5 executables for 9 distinct request sizes
+    assert pred._trace_count == 5
+
+
+def test_predictor_static_still_compiles_per_shape(tmp_path):
+    net, pred = _saved_predictor(tmp_path, dynamic=False)
+    for n in (1, 3, 5):
+        x = np.random.rand(n, 8).astype('float32')
+        out = pred.run([x])[0]
+        assert out.shape == (n, 4)
+        np.testing.assert_allclose(out, _fwd(net, x), atol=1e-5)
+    assert pred._trace_count == 3                # legacy: one per shape
+
+
+# ---------------------------------------------------------------------------
+# satellite: hapi Model predict paths
+# ---------------------------------------------------------------------------
+
+def test_model_predict_single_trace_with_ragged_tail():
+    net = _net()
+    model = paddle.Model(net)
+    model.prepare(None, None)
+    xs = np.random.rand(10, 8).astype('float32')
+    batches = [(xs[0:4],), (xs[4:8],), (xs[8:10],)]   # ragged tail of 2
+    out = model.predict(batches, stack_outputs=True)
+    assert out[0].shape == (10, 4)
+    np.testing.assert_allclose(out[0], _fwd(net, xs), atol=1e-5)
+    assert model._eval_traces == 1      # tail padded into the cached step
+
+    out2 = model.predict(batches, stack_outputs=True, bucket_pad=False)
+    np.testing.assert_allclose(out2[0], _fwd(net, xs), atol=1e-5)
+    assert model._eval_traces == 2      # opt-out retraces for the tail
+
+
+def test_model_predict_batch_sig_keyed_cache():
+    model = paddle.Model(_net())
+    model.prepare(None, None)
+    a = model.predict_batch([np.random.rand(4, 8).astype('float32')])
+    b = model.predict_batch([np.random.rand(4, 8).astype('float32')])
+    assert model._eval_traces == 1
+    assert len(model._eval_steps) == 1           # same signature, same entry
+    model.predict_batch([np.random.rand(2, 8).astype('float32')])
+    assert model._eval_traces == 2
+    assert len(model._eval_steps) == 2           # new signature, new entry
+    assert a[0].shape == b[0].shape == (4, 4)
+
+
+def test_model_predict_through_serving_engine():
+    net = _net()
+    model = paddle.Model(net)
+    model.prepare(None, None)
+    xs = np.random.rand(10, 8).astype('float32')
+    batches = [(xs[0:4],), (xs[4:8],), (xs[8:10],)]
+    out = model.predict(batches, stack_outputs=True, engine=True)
+    np.testing.assert_allclose(out[0], _fwd(net, xs), atol=1e-5)
+    st = model._engine.stats()
+    assert st['completed'] == 3
+    assert st['batches'] >= 1
+    model._engine.shutdown()
+
+
+def test_engine_from_trained_model_uses_live_weights():
+    """The engine must serve the async executor's device-resident weights,
+    not a stale pre-fit snapshot."""
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    xs = np.random.rand(8, 8).astype('float32')
+    ys = np.random.randint(0, 4, size=(8,)).astype('int64')
+    for _ in range(3):
+        model.train_batch([xs], [ys])
+    eng = InferenceEngine(model, max_batch_size=8, max_delay_ms=1.0)
+    out = eng.submit(xs).result(timeout=30)
+    net.eval()
+    np.testing.assert_allclose(out, _fwd(net, xs), atol=1e-5)
+    eng.shutdown()

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""CPU-safe serving benchmark: dynamic batching vs per-request Predictor.run.
+
+Drives a mixed-size request stream (batch sizes 1-17, the ISSUE-3 acceptance
+shape) through two serving paths over the SAME model and prints ONE json
+line:
+
+  - ``engine``: serving.InferenceEngine — requests coalesced into padded
+    power-of-two buckets, executed through the bucketed compile cache.
+  - ``per_request``: inference.Predictor.run called once per request (the
+    pre-serving status quo: one executable per distinct batch size, one
+    dispatch + host round-trip per request).
+
+Both paths are warmed first so compile time is excluded from the timed
+window; compile counts are reported separately (the engine must stay within
+``ceil(log2(max_batch)) + 1`` executables).
+
+Usage: python tools/serve_bench.py [--requests N] [--max-batch B]
+                                   [--delay-ms MS] [--sizes LO:HI]
+"""
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+IN_DIM, HIDDEN, OUT_DIM = 64, 256, 32
+
+
+def _make_net():
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(IN_DIM, HIDDEN), nn.ReLU(),
+                        nn.Linear(HIDDEN, HIDDEN), nn.ReLU(),
+                        nn.Linear(HIDDEN, OUT_DIM))
+    net.eval()
+    return net
+
+
+def _requests(n, lo, hi, seed=0):
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(lo, hi + 1, size=n)
+    return [rng.rand(s, IN_DIM).astype('float32') for s in sizes]
+
+
+def run_bench(requests=160, max_batch=64, delay_ms=2.0, lo=1, hi=17,
+              deadline_ms=None):
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.inference import Config, create_predictor
+
+    net = _make_net()
+    reqs = _requests(requests, lo, hi)
+
+    # ---- per-request Predictor baseline (jit.save -> attach_layer) -------
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, 'serve_bench_model')
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([-1, IN_DIM],
+                                                        'float32')])
+    pred = create_predictor(Config(path + '.pdmodel'))
+    pred.attach_layer(_make_net())
+    for s in sorted({r.shape[0] for r in reqs}):     # warm every shape
+        pred.run([reqs[0][:1].repeat(s, axis=0) if s else reqs[0]])
+    t0 = time.perf_counter()
+    for r in reqs:
+        pred.run([r])
+    per_request_s = time.perf_counter() - t0
+    rps_predictor = requests / per_request_s
+
+    # ---- engine ----------------------------------------------------------
+    engine = serving.InferenceEngine(net, max_batch_size=max_batch,
+                                     max_delay_ms=delay_ms,
+                                     queue_capacity=max(4 * requests, 256),
+                                     default_deadline_ms=deadline_ms)
+    # warm the bucket ladder so the timed window measures steady state
+    for b in serving.bucket_sizes(max_batch):
+        engine.submit(reqs[0][:1].repeat(b, axis=0)).result(timeout=60)
+    engine._stats.reset()
+    t0 = time.perf_counter()
+    futs = [engine.submit(r) for r in reqs]
+    outs = [f.result(timeout=60) for f in futs]
+    engine_s = time.perf_counter() - t0
+    rps_engine = requests / engine_s
+    stats = engine.stats()
+    engine.shutdown()
+
+    # correctness spot check: engine output == direct forward, real rows only
+    ref = np.asarray(net(paddle.to_tensor(reqs[0])))
+    ok = bool(np.allclose(outs[0], ref, atol=1e-4))
+
+    bucket_limit = int(math.ceil(math.log2(max_batch))) + 1
+    return {
+        'requests': requests,
+        'request_sizes': f'{lo}-{hi}',
+        'max_batch': max_batch,
+        'max_delay_ms': delay_ms,
+        'rps_engine': round(rps_engine, 1),
+        'rps_per_request_predictor': round(rps_predictor, 1),
+        'speedup': round(rps_engine / rps_predictor, 2),
+        'latency_ms_p50': stats['latency_ms_p50'],
+        'latency_ms_p99': stats['latency_ms_p99'],
+        'queue_wait_ms_p50': stats['queue_wait_ms_p50'],
+        'queue_wait_ms_p99': stats['queue_wait_ms_p99'],
+        'pad_waste_pct': stats['pad_waste_pct'],
+        'batch_occupancy': stats['batch_occupancy'],
+        'avg_batch_size': stats['avg_batch_size'],
+        'batches': stats['batches'],
+        'compiles_engine': stats['compiles'],
+        'compiles_predictor': pred._trace_count,
+        'bucket_limit': bucket_limit,
+        'compiles_ok': stats['compiles'] <= bucket_limit,
+        'outputs_match': ok,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--requests', type=int, default=160)
+    ap.add_argument('--max-batch', type=int, default=64)
+    ap.add_argument('--delay-ms', type=float, default=2.0)
+    ap.add_argument('--sizes', default='1:17',
+                    help='request batch-size range lo:hi')
+    args = ap.parse_args(argv)
+    lo, hi = (int(x) for x in args.sizes.split(':'))
+    out = run_bench(requests=args.requests, max_batch=args.max_batch,
+                    delay_ms=args.delay_ms, lo=lo, hi=hi)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
