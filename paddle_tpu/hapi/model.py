@@ -76,6 +76,7 @@ class Model:
         self._scale_cache = None
         self._step_timer = None
         self._engine = None
+        self._engine_kwargs = None
         self._async = os.environ.get('PADDLE_TPU_SYNC_EXECUTOR') != '1'
         try:
             self._inflight_window = max(
@@ -698,15 +699,38 @@ class Model:
         """
         loader = self._as_loader(test_data, batch_size, False)
         if engine is not None:
+            from ..serving.errors import QueueFullError
             eng = self.serving_engine() if engine is True else engine
-            futs = []
-            for batch in loader:
-                inputs, _ = self._split_batch(batch)
-                futs.append(eng.submit(*[np.asarray(i) for i in inputs]))
+            # bounded in-flight window: submitting the whole loader up front
+            # would trip the engine's own admission control (QueueFullError
+            # past queue_capacity). Results are consumed in submission order
+            # so output ordering is preserved.
+            window = max(1, getattr(eng, 'queue_capacity', 256) // 2)
+            pending = collections.deque()
             outputs = []
-            for f in futs:
+
+            def _consume(f):
                 res = f.result()
                 outputs.append(res if isinstance(res, list) else [res])
+
+            for batch in loader:
+                inputs, _ = self._split_batch(batch)
+                arrs = [np.asarray(i) for i in inputs]
+                while len(pending) >= window:
+                    _consume(pending.popleft())
+                while True:
+                    try:
+                        pending.append(eng.submit(*arrs))
+                        break
+                    except QueueFullError:
+                        # other submitters (or split chunks) filled the
+                        # queue: drain one of ours and retry
+                        if pending:
+                            _consume(pending.popleft())
+                        else:
+                            time.sleep(1e-3)
+            while pending:
+                _consume(pending.popleft())
         else:
             device_outs = []
             nominal = None
@@ -745,9 +769,16 @@ class Model:
         """Lazily build (and cache) a ``serving.InferenceEngine`` over this
         model's network — the dynamic-batching path for online traffic
         (``Model.predict(..., engine=True)`` routes through it)."""
+        if self._engine is not None and kwargs and \
+                kwargs != self._engine_kwargs:
+            # a different config was requested: rebuild instead of silently
+            # returning the previously-configured engine
+            self._engine.shutdown()
+            self._engine = None
         if self._engine is None:
             from ..serving import InferenceEngine
             self._engine = InferenceEngine(self, **kwargs)
+            self._engine_kwargs = kwargs
         return self._engine
 
     # ---- persistence -----------------------------------------------------

@@ -183,7 +183,8 @@ class Predictor:
             feed = [jnp.asarray(np.asarray(x)) for x in inputs]
         else:
             feed = [jnp.asarray(self._feed[n]) for n in self._input_names]
-        n_rows = None    # set when dynamic batching padded the feed
+        n_rows = None    # set when dynamic batching is on for this run
+        bucket = None    # the padded size actually compiled for
         if self._layer is None:
             if self._exec is None:
                 raise RuntimeError(
@@ -227,9 +228,12 @@ class Predictor:
             out = self._get_compiled(key)(*feed)
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs = [np.asarray(o) for o in outs]
-        if n_rows is not None and self._layer is not None:
+        if bucket is not None and bucket != n_rows:
+            # slice ONLY outputs whose leading dim is the padded batch;
+            # auxiliary outputs (e.g. a (heads, ...) attention map) whose
+            # shape[0] merely differs from n_rows must pass through intact
             outs = [o[:n_rows] if (getattr(o, 'ndim', 0) >= 1
-                                   and o.shape[0] != n_rows) else o
+                                   and o.shape[0] == bucket) else o
                     for o in outs]
         self._output_names = [f'out{i}' for i in range(len(outs))]
         self._results = dict(zip(self._output_names, outs))

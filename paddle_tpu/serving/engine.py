@@ -64,6 +64,11 @@ def _resolve_backend(net, precision):
         # into the Layer tree before we freeze a serving copy of it
         net._drain_inflight()
         net._sync_train_state()
+        # flip to eval through the Model's own mode tracker: a raw
+        # layer.eval() would leave _net_mode stale, making the next
+        # train_batch's _enter_mode(True) a no-op (training silently
+        # continuing with dropout off / BN frozen)
+        net._enter_mode(False)
         net = net.network
     if isinstance(net, Layer):
         return (net, param_arrays(net), buffer_arrays(net),
@@ -182,10 +187,16 @@ class InferenceEngine:
                 return
             self._closed = True
             self._draining = drain
+            # no dispatch thread (autostart=False, never submitted-to after
+            # manual start): nobody else will execute the admitted work, so
+            # drain it inline here rather than leaving waiters hanging
+            inline = drain and self._thread is None
             failed = [] if drain else self._queues.drain_all()
             self._cv.notify_all()
         for r in failed:
             r.future.set_exception(EngineClosedError('engine shut down'))
+        if inline:
+            self._drain_inline()
         if self._thread is not None:
             self._thread.join(timeout)
 
@@ -255,13 +266,28 @@ class InferenceEngine:
                     # sleep so aged groups are still noticed promptly
                     self._cv.wait(wait if wait is None
                                   else min(max(wait, 1e-4), 0.05))
-            try:
-                self._execute(*group)
-            except BaseException as e:     # never kill the dispatch thread
-                for r in group[1]:
-                    if not _future_done(r.future):
-                        r.future.set_exception(e)
-                self._stats.note_failed(len(group[1]))
+            self._run_group(group)
+
+    def _run_group(self, group):
+        try:
+            self._execute(*group)
+        except BaseException as e:     # never kill the dispatch thread
+            for r in group[1]:
+                if not _future_done(r.future):
+                    r.future.set_exception(e)
+            self._stats.note_failed(len(group[1]))
+
+    def _drain_inline(self):
+        """Execute everything already admitted on the caller's thread (used
+        by shutdown(drain=True) when no dispatch thread ever started)."""
+        while True:
+            with self._cv:
+                group = self._queues.take_ready(
+                    self._clock(), self.max_batch_size, self.max_delay_s,
+                    force=True)
+            if group is None:
+                return
+            self._run_group(group)
 
     def _execute(self, sig, reqs):
         now = self._clock()

@@ -349,3 +349,102 @@ def test_engine_from_trained_model_uses_live_weights():
     net.eval()
     np.testing.assert_allclose(out, _fwd(net, xs), atol=1e-5)
     eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_engine_does_not_freeze_training_mode():
+    """Building a serving engine mid-training must not leave the hapi Model
+    believing it is still in train mode while the Layer tree sits in eval
+    (dropout off, BN frozen) — the next train_batch has to flip back."""
+    net = nn.Sequential(nn.Linear(8, 16), nn.Dropout(0.5), nn.Linear(16, 4))
+    drop = net[1]
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    xs = np.random.rand(4, 8).astype('float32')
+    ys = np.random.randint(0, 4, size=(4,)).astype('int64')
+    model.train_batch([xs], [ys])
+    assert drop.training is True
+    eng = model.serving_engine(max_batch_size=8, max_delay_ms=1.0)
+    assert drop.training is False        # serving froze the tree...
+    model.train_batch([xs], [ys])
+    assert drop.training is True         # ...but training mode comes back
+    eng.shutdown()
+
+
+def test_predictor_dynamic_batch_keeps_aux_outputs_intact(tmp_path):
+    """Bucket-padding must slice only outputs whose leading dim is the
+    padded batch; a fixed-shape auxiliary output passes through whole."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    class WithAux(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            # aux leading dim (8) is not the batch and != n_rows below
+            return self.fc(x), paddle.to_tensor(np.eye(8, dtype='float32'))
+
+    net = WithAux()
+    net.eval()
+    path = str(tmp_path / 'aux')
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([-1, 8], 'float32')])
+    cfg = Config(path + '.pdmodel')
+    cfg.switch_batch_dim_dynamic()
+    pred = create_predictor(cfg)
+    pred.attach_layer(net)
+    x = np.random.rand(3, 8).astype('float32')   # pads 3 -> bucket 4
+    out, aux = pred.run([x])
+    assert out.shape == (3, 4)                   # batched output sliced
+    assert aux.shape == (8, 8)                   # aux output untouched
+    np.testing.assert_array_equal(aux, np.eye(8, dtype='float32'))
+    # exact-bucket request: no padding, nothing gets sliced
+    x4 = np.random.rand(4, 8).astype('float32')
+    out4, aux4 = pred.run([x4])
+    assert out4.shape == (4, 4) and aux4.shape == (8, 8)
+
+
+def test_model_predict_engine_bounded_inflight():
+    """predict(engine=...) over a loader longer than the engine queue must
+    not trip the engine's own admission control."""
+    net = _net()
+    model = paddle.Model(net)
+    model.prepare(None, None)
+    eng = InferenceEngine(model, max_batch_size=8, max_delay_ms=0.5,
+                          queue_capacity=4)
+    xs = np.random.rand(40, 8).astype('float32')
+    batches = [(xs[i:i + 2],) for i in range(0, 40, 2)]  # 20 > capacity 4
+    out = model.predict(batches, stack_outputs=True, engine=eng)
+    np.testing.assert_allclose(out[0], _fwd(net, xs), atol=1e-5)
+    eng.shutdown()
+
+
+def test_shutdown_drain_without_dispatch_thread_runs_inline():
+    """shutdown(drain=True) on an engine whose dispatch thread never
+    started must still execute admitted work — waiters must not hang."""
+    net = _net()
+    eng = InferenceEngine(net, max_batch_size=8, autostart=False)
+    x = np.random.rand(3, 8).astype('float32')
+    fut = eng.submit(x)
+    eng.shutdown(drain=True)
+    out = fut.result(timeout=30)                 # resolves, no hang
+    np.testing.assert_allclose(out, _fwd(net, x), atol=1e-5)
+
+
+def test_serving_engine_rebuilds_on_new_kwargs():
+    model = paddle.Model(_net())
+    model.prepare(None, None)
+    e1 = model.serving_engine(max_batch_size=4)
+    assert e1.max_batch_size == 4
+    assert model.serving_engine() is e1          # no kwargs: cached
+    assert model.serving_engine(max_batch_size=4) is e1   # same config
+    e2 = model.serving_engine(max_batch_size=8)  # new config: rebuilt
+    assert e2 is not e1 and e2.max_batch_size == 8
+    assert model.serving_engine() is e2
+    e2.shutdown()
