@@ -402,6 +402,49 @@ def _child_serving():
     print(json.dumps(serve_bench.run_bench(requests=160)))
 
 
+def _child_obs_overhead():
+    """Observability overhead probe: steps/s of a small hapi fit loop, run
+    by the parent twice (PADDLE_TPU_OBS=0 and =1) so the <5% budget of the
+    instrumented train path is tracked in BENCH_*.json. A tiny MLP keeps
+    device compute negligible — the measurement is dominated by exactly the
+    per-step host code the observability layer instruments."""
+    _arm_watchdog(300)
+    _force_cpu_if_requested()
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+
+    class _DS(Dataset):
+        def __len__(self):
+            return 2048
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return (rng.randn(64).astype('float32'),
+                    np.array([i % 10], dtype='int64'))
+
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 10))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    m.fit(_DS(), batch_size=32, epochs=1, verbose=0)   # warm compiles
+    steps_per_epoch = 2048 // 32
+    # median of several single-epoch timings: one fit() per sample so a
+    # transient load spike on the host skews one sample, not the number
+    rates = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        m.fit(_DS(), batch_size=32, epochs=1, verbose=0)
+        rates.append(steps_per_epoch / (time.perf_counter() - t0))
+    rates.sort()
+    from paddle_tpu import observability as obs
+    print(json.dumps({'steps_per_sec': rates[len(rates) // 2],
+                      'obs_enabled': obs.enabled()}))
+
+
 def _child_smoke():
     """30s pallas compile-smoke: compile+run the flash fwd AND bwd kernels on
     a tiny shape with a host-read fence. Run by the tunnel watcher on relay
@@ -750,6 +793,25 @@ def main(fast=False):
         else:
             print(f'eager microbench failed: {enote}', file=sys.stderr)
 
+        # observability overhead A/B: same fit loop with the metrics/trace
+        # layer hard-disabled vs enabled; budget is <5% steps/s regression
+        obs_res = {}
+        for flag in ('0', '1'):
+            r, onote = _run_child(
+                ['--child-obs-overhead'], 360,
+                env={'PADDLE_TPU_OBS': flag, 'BENCH_CHILD_TIMEOUT': '360'})
+            if r is None:
+                print(f'obs overhead (PADDLE_TPU_OBS={flag}) failed: {onote}',
+                      file=sys.stderr)
+                break
+            obs_res[flag] = r['steps_per_sec']
+        if len(obs_res) == 2:
+            off, on = obs_res['0'], obs_res['1']
+            out['obs_overhead_steps_per_sec_off'] = round(off, 2)
+            out['obs_overhead_steps_per_sec_on'] = round(on, 2)
+            out['obs_overhead_pct'] = round(100.0 * (off - on) / off, 2) \
+                if off > 0 else 0.0
+
     if platform != 'cpu':
         dec, dnote = _run_child(['--child-decode'], CONFIG_TIMEOUT_S)
         if dec is not None:
@@ -821,6 +883,8 @@ if __name__ == '__main__':
         _child_decode()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-serving':
         _child_serving()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':
+        _child_obs_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':
         _child_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == '--smoke':

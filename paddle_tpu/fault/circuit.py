@@ -6,17 +6,24 @@ half_open --success--> closed   |   --failure--> open (timer restarts)
 
 The clock is injectable so state transitions are deterministic in tests.
 """
+import itertools
 import threading
 import time
 
+from .. import observability as _obs
 from .errors import CircuitOpenError
 
 CLOSED = 'closed'
 OPEN = 'open'
 HALF_OPEN = 'half_open'
 
+# numeric encoding for the fault.circuit_state gauge
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
 
 class CircuitBreaker:
+    _seq = itertools.count()
+
     def __init__(self, failure_threshold=5, recovery_timeout=30.0,
                  half_open_max_calls=1, clock=None):
         self.failure_threshold = max(1, failure_threshold)
@@ -28,6 +35,25 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = None
         self._trial_calls = 0
+        self.labels = {'breaker': f'b{next(CircuitBreaker._seq)}'}
+        self._publish_state()
+
+    def _publish_state(self):
+        """Mirror the current state into the fault.circuit_state gauge
+        (0 closed / 1 open / 2 half_open). Looked up per call so runtime
+        enable/disable of observability is honored."""
+        _obs.gauge('fault.circuit_state',
+                   self.labels).set(_STATE_CODE[self._state])
+
+    def _transition(self, new_state):
+        old = self._state
+        self._state = new_state
+        if new_state != old:
+            self._publish_state()
+            _obs.record_event('fault.circuit_transition',
+                              frm=old, to=new_state, **self.labels)
+            if new_state == OPEN:
+                _obs.counter('fault.circuit_opened').inc()
 
     # ---- state ----------------------------------------------------------
     @property
@@ -39,20 +65,20 @@ class CircuitBreaker:
     def _maybe_half_open(self):
         if self._state == OPEN and \
                 self._clock() - self._opened_at >= self.recovery_timeout:
-            self._state = HALF_OPEN
+            self._transition(HALF_OPEN)
             self._trial_calls = 0
 
     def _open(self):
-        self._state = OPEN
         self._opened_at = self._clock()
         self._failures = 0
+        self._transition(OPEN)
 
     def reset(self):
         with self._lock:
-            self._state = CLOSED
             self._failures = 0
             self._opened_at = None
             self._trial_calls = 0
+            self._transition(CLOSED)
 
     # ---- accounting -----------------------------------------------------
     def allow(self):

@@ -93,6 +93,9 @@ def inject(point):
     if _rng.random() >= prob:
         return
     _fired += 1
+    from .. import observability as _obs
+    _obs.counter('fault.injected', {'point': point}).inc()
+    _obs.record_event('fault.injected', point=point, action=action)
     if action == 'kill':
         os.kill(os.getpid(), signal.SIGKILL)
     raise InjectedFault(point)

@@ -7,6 +7,7 @@ wall-clock flakiness.
 import random as _random
 import time
 
+from .. import observability as _obs
 from .errors import RetryError
 
 
@@ -27,6 +28,7 @@ def retry(fn, *, retries=3, deadline=None, backoff=0.1, factor=2.0,
     """
     clock = clock or time.monotonic
     sleep = sleep or time.sleep
+    _obs.counter('fault.retry_calls').inc()
     start = clock()
     attempt = 0
     while True:
@@ -35,6 +37,7 @@ def retry(fn, *, retries=3, deadline=None, backoff=0.1, factor=2.0,
         except exceptions as e:
             attempt += 1
             if attempt >= retries:
+                _obs.counter('fault.retry_exhausted').inc()
                 raise RetryError(
                     f'gave up after {attempt} attempt(s): {e!r}',
                     attempts=attempt) from e
@@ -43,9 +46,14 @@ def retry(fn, *, retries=3, deadline=None, backoff=0.1, factor=2.0,
                 r = rng.random() if rng is not None else _random.random()
                 delay *= 1.0 + jitter * r
             if deadline is not None and (clock() - start) + delay > deadline:
+                _obs.counter('fault.retry_exhausted').inc()
                 raise RetryError(
                     f'deadline {deadline}s exceeded after {attempt} '
                     f'attempt(s): {e!r}', attempts=attempt) from e
+            _obs.counter('fault.retries').inc()
+            _obs.record_event('fault.retry', attempt=attempt,
+                              delay_s=round(delay, 4),
+                              error=type(e).__name__)
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)

@@ -24,6 +24,7 @@ import zlib
 
 import numpy as np
 
+from . import observability as _obs
 from .core.tensor import Tensor
 from .fault import CheckpointCorruptError, UnsafePayloadError
 from .fault.inject import inject
@@ -136,30 +137,34 @@ def save(obj, path, protocol=4, **configs):
     """Atomic durable save: tmp file -> fsync -> os.replace, with a sidecar
     integrity manifest. A crash at any instant leaves either the previous
     complete checkpoint or the new complete one — never a truncated mix."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    payload_obj = _to_numpy(obj)
-    payload = pickle.dumps(payload_obj, protocol=protocol)
-    manifest = json.dumps(_build_manifest(payload_obj, payload),
-                          sort_keys=True).encode()
-    tmp = f'{path}.tmp.{os.getpid()}'
-    mtmp = f'{path}{MANIFEST_SUFFIX}.tmp.{os.getpid()}'
-    _sweep_stale_tmps(path)
-    try:
-        _write_fsync(tmp, payload)
-        _write_fsync(mtmp, manifest)
-        inject('ckpt.write')
-        os.replace(tmp, path)
-        inject('ckpt.commit')
-        os.replace(mtmp, path + MANIFEST_SUFFIX)
-        _fsync_dir(d or '.')
-    finally:
-        for t in (tmp, mtmp):
-            try:
-                os.remove(t)
-            except OSError:
-                pass
+    with _obs.span('ckpt.save', path=os.path.basename(path)) as sp:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        payload_obj = _to_numpy(obj)
+        payload = pickle.dumps(payload_obj, protocol=protocol)
+        manifest = json.dumps(_build_manifest(payload_obj, payload),
+                              sort_keys=True).encode()
+        tmp = f'{path}.tmp.{os.getpid()}'
+        mtmp = f'{path}{MANIFEST_SUFFIX}.tmp.{os.getpid()}'
+        _sweep_stale_tmps(path)
+        try:
+            _write_fsync(tmp, payload)
+            _write_fsync(mtmp, manifest)
+            inject('ckpt.write')
+            os.replace(tmp, path)
+            inject('ckpt.commit')
+            os.replace(mtmp, path + MANIFEST_SUFFIX)
+            _fsync_dir(d or '.')
+        finally:
+            for t in (tmp, mtmp):
+                try:
+                    os.remove(t)
+                except OSError:
+                    pass
+    _obs.counter('ckpt.saves').inc()
+    _obs.counter('ckpt.bytes_written').inc(len(payload) + len(manifest))
+    _obs.histogram('ckpt.save_ms').observe(1e3 * sp.duration)
 
 
 # ---- restricted unpickling --------------------------------------------------
@@ -283,6 +288,15 @@ def load(path, **configs):
     when a sidecar exists; legacy manifest-less files still load, through
     the restricted unpickler) or a directory of checkpoints (falls back to
     the newest intact one)."""
-    if os.path.isdir(path):
-        return _load_newest(path)
-    return _load_file(path)
+    with _obs.span('ckpt.load', path=os.path.basename(path)) as sp:
+        try:
+            if os.path.isdir(path):
+                out = _load_newest(path)
+            else:
+                out = _load_file(path)
+        except CheckpointCorruptError:
+            _obs.counter('ckpt.corrupt_total').inc()
+            raise
+    _obs.counter('ckpt.loads').inc()
+    _obs.histogram('ckpt.load_ms').observe(1e3 * sp.duration)
+    return out

@@ -347,6 +347,54 @@ class VisualDL(Callback):
                                                    if isinstance(v, (int, float))}}) + '\n')
 
 
+class MetricsExporter(Callback):
+    """Periodic observability export during training.
+
+    Writes the full ``observability.snapshot()`` as JSONL (one line per
+    export, so a run's history is greppable) every ``every_n_epochs``, and
+    a complete dump (``snapshot.json`` / ``metrics.prom`` / ``trace.json``)
+    into ``log_dir`` at train end. No-ops cheaply when observability is
+    disabled (``PADDLE_TPU_OBS=0``)."""
+
+    def __init__(self, log_dir='./obs_log', every_n_epochs=1,
+                 prometheus=True, trace=True):
+        super().__init__()
+        self.log_dir = log_dir
+        self.every_n_epochs = max(1, int(every_n_epochs))
+        self.prometheus = prometheus
+        self.trace = trace
+
+    def _obs(self):
+        from .. import observability
+        return observability
+
+    def on_epoch_end(self, epoch, logs=None):
+        obs = self._obs()
+        if not obs.enabled() or (epoch + 1) % self.every_n_epochs:
+            return
+        import json
+        os.makedirs(self.log_dir, exist_ok=True)
+        snap = obs.snapshot()
+        snap['epoch'] = epoch
+        with open(os.path.join(self.log_dir, 'snapshots.jsonl'), 'a') as f:
+            f.write(json.dumps(snap, sort_keys=True, default=str) + '\n')
+
+    def on_train_end(self, logs=None):
+        obs = self._obs()
+        if not obs.enabled():
+            return
+        import json
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, 'snapshot.json'), 'w') as f:
+            json.dump(obs.snapshot(), f, indent=1, sort_keys=True,
+                      default=str)
+        if self.prometheus:
+            with open(os.path.join(self.log_dir, 'metrics.prom'), 'w') as f:
+                f.write(obs.to_prometheus())
+        if self.trace:
+            obs.dump_trace(os.path.join(self.log_dir, 'trace.json'))
+
+
 class ReduceLROnPlateau(Callback):
     def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
                  mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..core import tensor as _core_tensor
 from ..core.tensor import DeviceResidentRef, Tensor, no_grad_ctx
 from ..nn.layer_base import Layer, functional_call
@@ -477,6 +478,7 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self._enter_mode(False)
+        _obs.counter('train.eval_batches').inc()
         inputs = [self._as_device(t) for t in _to_list(inputs)]
         labels = [self._as_device(t) for t in _to_list(labels)]
         # cache keyed on (mode, input signature) like the train path keys on
@@ -559,6 +561,14 @@ class Model:
                 skip_steps = info['step'] + 1
             it_count = info.get('global_step', 0)
         use_prefetch = self._async and isinstance(loader, DataLoader)
+        # manual enter/exit: the whole epoch loop is one 'train.fit' span
+        # without re-indenting it (complete events nest by ts/dur anyway)
+        fit_span = _obs.span('train.fit', epochs=epochs,
+                             start_epoch=start_epoch)
+        fit_span.__enter__()
+        step_ms = _obs.histogram('train.step_ms')
+        step_counter = _obs.counter('train.steps')
+        loss_gauge = _obs.gauge('train.loss')
         for epoch in range(start_epoch, epochs):
             if auto_resume is not None:
                 # deterministic per-epoch shuffle so a resumed lifetime sees
@@ -585,7 +595,11 @@ class Model:
                     do_update = (step_idx + 1) % accumulate_grad_batches == 0
                     if timer is not None:
                         t0 = time.perf_counter()
-                    loss = self.train_batch(inputs, labels, update=do_update)
+                    with _obs.span('train.step', step=it_count) as sp:
+                        loss = self.train_batch(inputs, labels,
+                                                update=do_update)
+                    step_ms.observe(1e3 * sp.duration)
+                    step_counter.inc()
                     if timer is not None:
                         timer.add('dispatch', time.perf_counter() - t0)
                     lval = loss[0]
@@ -597,6 +611,7 @@ class Model:
                         lval = float(np.asarray(lval))
                         if timer is not None:
                             timer.add('readback', time.perf_counter() - t0)
+                        loss_gauge.set(lval)
                     logs = {'loss': lval, 'step': step_idx}
                     self._update_metrics(logs, inputs, labels)
                     cbks.on_batch_end('train', step_idx, logs)
@@ -622,8 +637,10 @@ class Model:
                 eval_logs = self.evaluate(eval_loader, verbose=0)
                 logs.update({'eval_' + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
+            _obs.counter('train.epochs').inc()
             if self.stop_training:
                 break
+        fit_span.__exit__(None, None, None)
         # fit() exit is a read point: device-resident state flows back into
         # the Layer objects before user code (or on_train_end callbacks,
         # e.g. the final ModelCheckpoint) can look at them

@@ -8,6 +8,7 @@ buffering) so the chip never waits on input.
 """
 import itertools
 import math
+import time
 
 import numpy as np
 
@@ -305,7 +306,9 @@ class DataLoader:
                      jitter=0.5)
 
     def _iter_sync(self):
+        from .. import observability as _obs
         from ..fault.inject import inject
+        batches = _obs.counter('data.batches')
         if self._iterable_mode:
             it = iter(self.dataset)
             while True:
@@ -315,10 +318,12 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 inject('dataloader.step')
+                batches.inc()
                 yield self.collate_fn(batch)
         else:
             for idxs in self.batch_sampler:
                 inject('dataloader.step')
+                batches.inc()
                 yield self.collate_fn([self._fetch(i) for i in idxs])
 
     def _warn_native(self, exc, what):
@@ -333,6 +338,7 @@ class DataLoader:
         """Native C++ worker pool with graceful degrade: if the pool cannot
         start or dies mid-epoch, finish the epoch synchronously from the
         first undelivered batch — one warning, no data loss."""
+        from .. import observability as _obs
         from ..fault.inject import inject
         try:
             from .native_loader import NativeWorkerIterator
@@ -341,6 +347,7 @@ class DataLoader:
             self._warn_native(e, 'unavailable')
             yield from self._iter_sync()
             return
+        batches = _obs.counter('data.batches')
         delivered = 0
         while True:
             try:
@@ -351,10 +358,12 @@ class DataLoader:
                 self._warn_native(e, 'failed mid-epoch')
                 for idxs in it.batches[delivered:]:
                     inject('dataloader.step')
+                    batches.inc()
                     yield self.collate_fn([self._fetch(i) for i in idxs])
                 return
             delivered += 1
             inject('dataloader.step')
+            batches.inc()
             yield batch
 
     def __iter__(self):
@@ -379,6 +388,7 @@ class DataLoader:
 
         import jax
 
+        from .. import observability as _obs
         from ..fault.inject import inject
 
         depth = max(1, int(n))
@@ -391,9 +401,16 @@ class DataLoader:
                             else self.collate_fn)
 
             def _host_gen():
+                collate_ms = _obs.histogram('data.collate_ms')
+                n_batches = _obs.counter('data.batches')
                 for idxs in batches:
                     inject('dataloader.step')
-                    yield host_collate([self._fetch(i) for i in idxs])
+                    with _obs.span('data.host_collate',
+                                   rows=len(idxs)) as sp:
+                        b = host_collate([self._fetch(i) for i in idxs])
+                    collate_ms.observe(1e3 * sp.duration)
+                    n_batches.inc()
+                    yield b
 
             host_iter = _host_gen()
 
@@ -436,6 +453,8 @@ class DataLoader:
             thread.start()
             pending = collections.deque()
             done = False
+            device_put_ms = _obs.histogram('data.device_put_ms')
+            prefetched = _obs.counter('data.prefetch_batches')
             try:
                 while True:
                     # keep up to ``depth`` batches already on device so the
@@ -447,7 +466,11 @@ class DataLoader:
                         elif tag is _ERR:
                             raise payload
                         else:
+                            t0 = time.perf_counter()
                             pending.append(_to_device(payload))
+                            device_put_ms.observe(
+                                1e3 * (time.perf_counter() - t0))
+                            prefetched.inc()
                     if not pending:
                         return
                     yield pending.popleft()

@@ -1,0 +1,90 @@
+"""paddle_tpu.observability — unified metrics, trace spans, run telemetry.
+
+The cross-cutting telemetry spine: one process-wide metrics registry
+(Counter/Gauge/Histogram with labels, JSON + Prometheus export) and one
+structured span tracer (Chrome-trace/Perfetto export, wraps
+``jax.profiler.TraceAnnotation`` when available). Every subsystem reports
+through it under a shared namespace:
+
+- ``train.*`` — hapi fit loop, StepTimer phase breakdown, eval batches
+- ``serve.*`` — InferenceEngine admission/batching/compile/execute
+- ``fault.*`` — retries, circuit breakers, injected faults
+- ``ckpt.*``  — framework_io save/load, CheckpointManager save/restore
+- ``data.*``  — DataLoader batches, host collation, device prefetch
+
+Quick start::
+
+    from paddle_tpu import observability as obs
+    model.fit(loader, epochs=3)
+    snap = obs.snapshot()                  # JSON-able dict of every metric
+    print(obs.to_prometheus())             # text exposition format
+    obs.dump_trace('trace.json')           # load in chrome://tracing
+    obs.dump('run_dump/')                  # snapshot + prom + trace
+
+Env knobs:
+
+- ``PADDLE_TPU_OBS=0`` hard-disables the layer: metric helpers return one
+  shared no-op singleton and ``span()`` returns a no-op context manager —
+  near-zero overhead on every instrumented hot path.
+- ``PADDLE_TPU_OBS_DUMP=<dir>`` writes ``snapshot.json`` /
+  ``metrics.prom`` / ``trace.json`` into ``<dir>`` at process exit.
+- ``PADDLE_TPU_OBS_TRACE_CAP`` bounds the span ring buffer (default 1e5).
+"""
+import atexit
+import os
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                       NULL_METRIC, counter, enabled, fmt_key, gauge,
+                       histogram, percentile, registry, set_enabled,
+                       snapshot, to_prometheus)
+from .trace import (NULL_SPAN, Span, dump_trace, record_event,  # noqa: F401
+                    reset_trace, span, trace_events)
+
+ENV_OBS = 'PADDLE_TPU_OBS'
+ENV_DUMP = 'PADDLE_TPU_OBS_DUMP'
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'Span',
+    'counter', 'gauge', 'histogram', 'registry', 'span', 'record_event',
+    'snapshot', 'to_prometheus', 'trace_events', 'dump_trace', 'dump',
+    'enabled', 'set_enabled', 'reset', 'percentile',
+]
+
+
+def reset():
+    """Clear the default registry AND the trace ring (tests, run restarts).
+    Metric objects already held by views keep working but are no longer
+    exported until re-created."""
+    registry().reset()
+    reset_trace()
+
+
+def dump(directory):
+    """Write the full observability state into ``directory``:
+    ``snapshot.json`` (metrics), ``metrics.prom`` (Prometheus text
+    exposition), ``trace.json`` (Chrome trace). Returns the paths written.
+    ``tools/obs_report.py`` renders a one-page report from such a dump."""
+    import json
+    os.makedirs(directory, exist_ok=True)
+    paths = {}
+    paths['snapshot'] = os.path.join(directory, 'snapshot.json')
+    with open(paths['snapshot'], 'w') as f:
+        json.dump(snapshot(), f, indent=1, sort_keys=True, default=str)
+    paths['prometheus'] = os.path.join(directory, 'metrics.prom')
+    with open(paths['prometheus'], 'w') as f:
+        f.write(to_prometheus())
+    paths['trace'] = os.path.join(directory, 'trace.json')
+    dump_trace(paths['trace'])
+    return paths
+
+
+def _dump_on_exit(directory):
+    try:
+        dump(directory)
+    except Exception:        # never fail interpreter shutdown on telemetry
+        pass
+
+
+_dump_dir = os.environ.get(ENV_DUMP)
+if _dump_dir and enabled():
+    atexit.register(_dump_on_exit, _dump_dir)

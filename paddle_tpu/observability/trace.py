@@ -1,0 +1,177 @@
+"""Structured span tracer with Chrome-trace/Perfetto JSON export.
+
+``span("train.step", step=n)`` is a context manager that records wall and
+monotonic timing for the enclosed region and, when the platform provides
+it, forwards the region to ``jax.profiler.TraceAnnotation`` so spans also
+show up inside TensorBoard/XProf device traces (the arxiv 2108.11076
+pattern: host-side structure made legible next to TPU utilization).
+
+Completed spans land in a bounded process-wide ring buffer as Chrome
+trace-event dicts (``ph: 'X'`` complete events; ``event()`` emits
+``ph: 'i'`` instants). ``dump_trace(path)`` writes a file that loads
+directly in ``chrome://tracing`` / Perfetto. Nesting needs no explicit
+parent tracking — complete events on the same tid nest by ts/dur.
+
+When observability is disabled, ``span()`` returns one shared no-op
+singleton: no allocation, no timestamps, no buffer writes.
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+from .registry import cfg
+
+TRACE_CAP = int(os.environ.get('PADDLE_TPU_OBS_TRACE_CAP', '100000'))
+
+_lock = threading.Lock()
+_events = collections.deque(maxlen=TRACE_CAP)
+_origin_mono = time.perf_counter()
+_origin_wall = time.time()
+
+_jax_profiler_mod = None
+_jax_profiler_checked = False
+
+
+def _jax_profiler():
+    """jax.profiler if importable, else None (cached). The TraceAnnotation
+    attribute is looked up per use so platform stubs (and tests) that
+    remove or break it degrade the span to host-only timing."""
+    global _jax_profiler_mod, _jax_profiler_checked
+    if not _jax_profiler_checked:
+        try:
+            from jax import profiler as _p
+            _jax_profiler_mod = _p
+        except Exception:
+            _jax_profiler_mod = None
+        _jax_profiler_checked = True
+    return _jax_profiler_mod
+
+
+def _now_us():
+    return (time.perf_counter() - _origin_mono) * 1e6
+
+
+class Span:
+    """One timed region. Use via ``observability.span(name, **attrs)``."""
+
+    __slots__ = ('name', 'attrs', 'duration', 'wall_start', '_ts', '_ann')
+
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = attrs or None
+        self.duration = 0.0          # monotonic seconds, set on exit
+        self.wall_start = 0.0
+        self._ts = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        mod = _jax_profiler()
+        if mod is not None:
+            try:
+                ann = mod.TraceAnnotation(self.name)
+                ann.__enter__()
+                self._ann = ann
+            except Exception:
+                self._ann = None
+        self.wall_start = time.time()
+        self._ts = _now_us()
+        return self
+
+    def event(self, name, **attrs):
+        """Instant event stamped inside this span's thread/timeline."""
+        record_event(name, **attrs)
+
+    def __exit__(self, etype, evalue, tb):
+        end = _now_us()
+        self.duration = (end - self._ts) / 1e6
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._ann = None
+        args = dict(self.attrs) if self.attrs else {}
+        if etype is not None:
+            args['error'] = f'{etype.__name__}: {evalue}'[:200]
+        rec = {'name': self.name, 'ph': 'X', 'cat': self.name.split('.')[0],
+               'ts': round(self._ts, 3), 'dur': round(end - self._ts, 3),
+               'pid': os.getpid(), 'tid': threading.get_ident()}
+        if args:
+            rec['args'] = args
+        with _lock:
+            _events.append(rec)
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+    duration = 0.0
+    wall_start = 0.0
+    name = ''
+    attrs = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def event(self, name, **attrs):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+def span(name, **attrs):
+    """``with span('serve.batch', bucket=8):`` — returns the no-op singleton
+    when observability is disabled."""
+    if not cfg.enabled:
+        return NULL_SPAN
+    return Span(name, attrs)
+
+
+def record_event(name, **attrs):
+    """Standalone instant event (``ph: 'i'``) — fault injections, retries,
+    circuit transitions."""
+    if not cfg.enabled:
+        return
+    rec = {'name': name, 'ph': 'i', 'cat': name.split('.')[0], 's': 't',
+           'ts': round(_now_us(), 3), 'pid': os.getpid(),
+           'tid': threading.get_ident()}
+    if attrs:
+        rec['args'] = attrs
+    with _lock:
+        _events.append(rec)
+
+
+def trace_events():
+    """Copy of the completed-event ring (Chrome trace-event dicts)."""
+    with _lock:
+        return list(_events)
+
+
+def reset_trace():
+    with _lock:
+        _events.clear()
+
+
+def dump_trace(path):
+    """Write the span ring as Chrome-trace JSON (loads in chrome://tracing
+    and Perfetto). Returns the event count written."""
+    with _lock:
+        events = list(_events)
+    meta = [{'name': 'process_name', 'ph': 'M', 'pid': os.getpid(),
+             'args': {'name': 'paddle_tpu'}}]
+    doc = {'traceEvents': meta + events,
+           'displayTimeUnit': 'ms',
+           'otherData': {'wall_origin': _origin_wall,
+                         'clock': 'perf_counter_us_since_origin'}}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'w') as f:
+        json.dump(doc, f, default=str)
+    return len(events)

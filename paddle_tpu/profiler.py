@@ -4,9 +4,12 @@ TPU-native: wraps jax.profiler — traces go to TensorBoard-compatible
 protobufs; RecordEvent maps to jax.profiler.TraceAnnotation.
 """
 import contextlib
+import itertools
 import time
 
 import jax
+
+from . import observability as _obs
 
 
 class ProfilerTarget:
@@ -16,9 +19,17 @@ class ProfilerTarget:
 
 
 class RecordEvent:
+    """User-facing profiling region. Forwards to an observability span
+    (which itself wraps ``jax.profiler.TraceAnnotation`` when the platform
+    provides it, and degrades to host-only timing otherwise).
+
+    Misuse-hardened: ``begin()`` while already active is a no-op (no leaked
+    second annotation), ``end()`` without a matching ``begin()`` is a
+    no-op instead of an AttributeError."""
+
     def __init__(self, name, event_type=None):
         self.name = name
-        self._ann = None
+        self._span = None
 
     def __enter__(self):
         self.begin()
@@ -29,13 +40,25 @@ class RecordEvent:
         return False
 
     def begin(self):
-        self._ann = jax.profiler.TraceAnnotation(self.name)
-        self._ann.__enter__()
+        if self._span is not None:      # already active: do not re-enter
+            return
+        from .observability import trace as _trace
+        span = _trace.Span(self.name)
+        try:
+            span.__enter__()
+        except Exception:               # host-only fallback of last resort
+            span = _trace.NULL_SPAN
+        self._span = span
 
     def end(self):
-        if self._ann is not None:
-            self._ann.__exit__(None, None, None)
-            self._ann = None
+        span = self._span
+        if span is None:                # end() without begin(): no-op
+            return
+        self._span = None
+        try:
+            span.__exit__(None, None, None)
+        except Exception:
+            pass
 
 
 class Profiler:
@@ -133,13 +156,11 @@ class ProfilerOptions:
 
 
 def percentile(samples, q):
-    """Nearest-rank percentile of an (unsorted) sample sequence; q in
-    [0, 100]. Shared by StepTimer and the serving metrics so every latency
-    number in the framework is computed the same way."""
-    if not samples:
-        return 0.0
-    s = sorted(samples)
-    return s[min(len(s) - 1, int(len(s) * q / 100.0))]
+    """Nearest-rank percentile — delegates to the one canonical
+    implementation in :mod:`paddle_tpu.observability.registry`. Returns
+    ``None`` for empty input, the lone element for a single sample, and
+    clamps q into [0, 100]."""
+    return _obs.percentile(samples, q)
 
 
 class StepTimer:
@@ -149,18 +170,51 @@ class StepTimer:
     overhead to enqueue the compiled step — what the async executor
     minimizes), ``readback`` (blocking D2H loss resolution at logging
     points). Attach with ``model._step_timer = StepTimer()`` before fit();
-    read ``summary()`` after."""
+    read ``summary()`` after.
+
+    Since the observability PR this is a *view* over registry histograms:
+    each instance owns ``train.{phase}_ms{timer=tN}`` series (values in
+    milliseconds) plus a ``train.timer_steps`` counter, so the same numbers
+    ``summary()`` reports are visible in ``observability.snapshot()``.
+    When observability is disabled the timer keeps working on private,
+    unregistered metric objects."""
 
     PHASES = ('data', 'dispatch', 'readback')
+    _seq = itertools.count()
 
     def __init__(self):
+        self.labels = {'timer': f't{next(StepTimer._seq)}'}
+        self._hists = {}
+        self._steps = None
         self.reset()
 
+    def _histogram(self, phase):
+        h = self._hists.get(phase)
+        if h is None:
+            name = f'train.{phase}_ms'
+            if _obs.enabled():
+                h = _obs.registry().histogram(name, self.labels)
+            else:
+                h = _obs.Histogram(name, self.labels)
+            self._hists[phase] = h
+        return h
+
     def reset(self):
-        self._samples = {p: [] for p in self.PHASES}
+        self._hists.clear()
+        if _obs.enabled():
+            self._steps = _obs.registry().counter(
+                'train.timer_steps', self.labels)
+        else:
+            self._steps = _obs.Counter('train.timer_steps', self.labels)
+        self._steps.reset()
+        for p in self.PHASES:
+            self._histogram(p).reset()
         self._pending = {p: 0.0 for p in self.PHASES}
-        self.steps = 0
         self._t_start = time.perf_counter()
+
+    @property
+    def steps(self):
+        return int(self._steps.value)
 
     def add(self, phase, seconds):
         self._pending[phase] = self._pending.get(phase, 0.0) + seconds
@@ -188,21 +242,23 @@ class StepTimer:
 
     def step_done(self):
         for p, v in self._pending.items():
-            self._samples.setdefault(p, []).append(v)
-        self._pending = {p: 0.0 for p in self._samples}
-        self.steps += 1
+            self._histogram(p).observe(1e3 * v)
+        self._pending = {p: 0.0 for p in self._pending}
+        self._steps.inc()
 
     def summary(self):
         wall = time.perf_counter() - self._t_start
-        out = {'steps': self.steps,
+        steps = self.steps
+        out = {'steps': steps,
                'wall_s': wall,
-               'steps_per_sec': self.steps / wall if wall > 0 else 0.0}
-        for p, xs in self._samples.items():
-            if not xs:
+               'steps_per_sec': steps / wall if wall > 0 else 0.0}
+        for p, h in self._hists.items():
+            c = h.count
+            if not c:
                 continue
-            out[p + '_ms_mean'] = 1e3 * sum(xs) / len(xs)
-            out[p + '_ms_p50'] = 1e3 * percentile(xs, 50)
-            out[p + '_ms_p99'] = 1e3 * percentile(xs, 99)
+            out[p + '_ms_mean'] = h.sum / c
+            out[p + '_ms_p50'] = h.percentile(50)
+            out[p + '_ms_p99'] = h.percentile(99)
         return out
 
 

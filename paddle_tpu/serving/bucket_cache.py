@@ -9,6 +9,8 @@ IS the executable count the serve benchmark asserts on.
 """
 import threading
 
+from .. import observability as _obs
+
 
 class BucketCompileCache:
     def __init__(self, builder):
@@ -22,9 +24,15 @@ class BucketCompileCache:
         with self._lock:
             fn = self._fns.get(key)
             if fn is None:
-                fn = self._builder(bucket, sig, precision)
+                with _obs.span('serve.compile', bucket=bucket,
+                               precision=str(precision)) as sp:
+                    fn = self._builder(bucket, sig, precision)
                 self._fns[key] = fn
                 self.misses += 1
+                _obs.counter('serve.compiles',
+                             {'bucket': str(bucket)}).inc()
+                _obs.histogram('serve.compile_ms').observe(
+                    1e3 * sp.duration)
         return fn
 
     def __len__(self):

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import fault
+from .. import observability as _obs
 from .batcher import (PendingQueues, Request, SplitJoin, normalize_request)
 from .bucket_cache import BucketCompileCache
 from .bucketing import bucket_for, bucket_sizes, pad_rows
@@ -311,6 +312,7 @@ class InferenceEngine:
                 for i in range(n_in)]
         padded = [pad_rows(c, bucket) for c in cols]
         t0 = time.perf_counter()
+        misses_before = self._cache.misses
 
         def device_call():
             fault.inject('serving.dispatch')
@@ -321,13 +323,23 @@ class InferenceEngine:
             return [np.asarray(o) for o in outs]
 
         try:
-            outs = self._breaker.call(device_call)
+            with _obs.span('serve.batch', bucket=bucket, rows=rows,
+                           requests=len(live)):
+                outs = self._breaker.call(device_call)
         except Exception as e:
             for r in live:
                 r.future.set_exception(e)
             self._stats.note_failed(len(live))
             return
         exec_s = time.perf_counter() - t0
+        blbl = {'bucket': str(bucket)}
+        if self._cache.misses > misses_before:
+            # first execution at this bucket: includes trace+compile cost
+            _obs.histogram('serve.first_exec_ms', blbl).observe(1e3 * exec_s)
+        else:
+            _obs.histogram('serve.bucket_exec_ms', blbl).observe(1e3 * exec_s)
+        _obs.counter('serve.bucket_rows', blbl).inc(rows)
+        _obs.counter('serve.bucket_padded_rows', blbl).inc(bucket)
         done_t = self._clock()
         off = 0
         for r in live:

@@ -20,6 +20,7 @@ import re
 
 import numpy as np
 
+from .. import observability as _obs
 from ..fault import CheckpointCorruptError, retry
 
 _STEP_RE = re.compile(r'^ckpt-(\d+)\.pdckpt$')
@@ -175,10 +176,12 @@ class CheckpointManager:
         """state: pytree of arrays (params/opt_state/buffers/meta). Retried
         on transient write errors; atomic either way (a crash mid-save never
         clobbers the previous step)."""
-        retry(lambda: self._be.save(step, state),
-              retries=self.save_retries, backoff=0.1, jitter=0.25)
-        if wait:
-            self._be.wait()
+        with _obs.span('ckpt.manager_save', step=step):
+            retry(lambda: self._be.save(step, state),
+                  retries=self.save_retries, backoff=0.1, jitter=0.25)
+            if wait:
+                self._be.wait()
+        _obs.counter('ckpt.manager_saves').inc()
 
     def latest_step(self):
         """Newest VERIFIED step (local backend verifies CRC manifests)."""
@@ -191,7 +194,10 @@ class CheckpointManager:
         step = step if step is not None else self._be.latest_step()
         if step is None:
             return None
-        return self._be.restore(step, template)
+        with _obs.span('ckpt.restore', step=step):
+            out = self._be.restore(step, template)
+        _obs.counter('ckpt.restores').inc()
+        return out
 
     def wait(self):
         self._be.wait()

@@ -1,0 +1,489 @@
+"""Observability layer: registry semantics, span tracing, wiring views.
+
+Covers the ISSUE-4 test checklist: counter/gauge/histogram semantics under
+threads, span nesting + Chrome-trace JSON schema, the disabled-mode
+zero-allocation fast path, and regression tests that ``engine.stats()``
+and ``StepTimer.summary()`` report the same numbers the registry exports.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import NULL_METRIC, NULL_SPAN
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts from an enabled, empty registry + trace ring and
+    leaves the process the same way."""
+    obs.set_enabled(True)
+    obs.reset()
+    yield
+    obs.set_enabled(True)
+    obs.reset()
+
+
+# ---- registry semantics ----------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    c = obs.counter('t.c')
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = obs.gauge('t.g')
+    g.set(3.5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2.5
+    h = obs.histogram('t.h')
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    st = h.stats()
+    assert st['count'] == 4 and st['sum'] == 10.0 and st['mean'] == 2.5
+    assert st['min'] == 1.0 and st['max'] == 4.0
+    assert st['p50'] == 3.0 and st['p99'] == 4.0
+
+
+def test_same_name_labels_returns_same_child():
+    assert obs.counter('t.c', {'a': '1'}) is obs.counter('t.c', {'a': '1'})
+    assert obs.counter('t.c', {'a': '1'}) is not obs.counter('t.c',
+                                                             {'a': '2'})
+    # label order must not matter
+    assert obs.gauge('t.g2', {'x': 1, 'y': 2}) is obs.gauge(
+        't.g2', {'y': 2, 'x': 1})
+
+
+def test_type_conflict_raises():
+    obs.counter('t.conflict')
+    with pytest.raises(ValueError):
+        obs.gauge('t.conflict')
+    with pytest.raises(ValueError):
+        obs.histogram('t.conflict')
+
+
+def test_snapshot_and_prometheus_export():
+    obs.counter('t.c', {'k': 'v'}).inc(7)
+    obs.gauge('t.g').set(1.5)
+    obs.histogram('t.h').observe(2.0)
+    snap = obs.snapshot()
+    assert snap['counters']['t.c{k=v}'] == 7
+    assert snap['gauges']['t.g'] == 1.5
+    assert snap['histograms']['t.h']['count'] == 1
+    assert json.loads(json.dumps(snap, default=str))   # JSON-serializable
+    prom = obs.to_prometheus()
+    assert '# TYPE t_c counter' in prom
+    assert 't_c{k="v"} 7' in prom
+    assert '# TYPE t_h summary' in prom
+    assert 't_h_count 1' in prom
+
+
+def test_histogram_window_bounded():
+    h = obs.histogram('t.win', window=8)
+    for i in range(100):
+        h.observe(float(i))
+    assert h.count == 100            # lifetime count survives the window
+    assert h.sum == float(sum(range(100)))
+    assert h.percentile(0) == 92.0   # window holds only the last 8
+
+
+def test_registry_thread_safety():
+    n_threads, per_thread = 8, 500
+    c = obs.counter('t.mt')
+    h = obs.histogram('t.mt_h', window=n_threads * per_thread)
+
+    def work():
+        for i in range(per_thread):
+            c.inc()
+            h.observe(float(i))
+            # concurrent creation of the same family must be safe too
+            obs.counter('t.mt_new', {'t': str(i % 4)}).inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    total = sum(v for k, v in obs.snapshot()['counters'].items()
+                if k.startswith('t.mt_new'))
+    assert total == n_threads * per_thread
+
+
+def test_percentile_edge_cases():
+    assert obs.percentile([], 50) is None
+    assert obs.percentile([7], 0) == 7
+    assert obs.percentile([7], 100) == 7
+    assert obs.percentile([3, 1, 2], -10) == 1     # clamped, not wrapped
+    assert obs.percentile([3, 1, 2], 250) == 3
+    from paddle_tpu.profiler import percentile as prof_pct
+    assert prof_pct([], 50) is None                # the deduped re-export
+    assert prof_pct([5], 99) == 5
+
+
+# ---- span tracer -----------------------------------------------------------
+
+def test_span_nesting_and_chrome_trace_schema(tmp_path):
+    with obs.span('train.fit', epochs=1):
+        with obs.span('train.step', step=0) as sp:
+            sp.event('train.marker', note='inner')
+            time.sleep(0.002)
+    assert sp.duration >= 0.002
+    events = obs.trace_events()
+    names = [e['name'] for e in events]
+    assert names == ['train.marker', 'train.step', 'train.fit']
+    step = events[1]
+    fit = events[2]
+    # Chrome trace-event schema: complete events with µs ts/dur
+    for ev in (step, fit):
+        assert ev['ph'] == 'X'
+        assert isinstance(ev['ts'], float) and isinstance(ev['dur'], float)
+        assert ev['pid'] and ev['tid']
+    assert step['cat'] == 'train'
+    assert step['args']['step'] == 0
+    # nesting is implicit via ts/dur on the same tid
+    assert fit['ts'] <= step['ts']
+    assert fit['ts'] + fit['dur'] >= step['ts'] + step['dur']
+    marker = events[0]
+    assert marker['ph'] == 'i' and marker['args']['note'] == 'inner'
+
+    path = tmp_path / 'trace.json'
+    n = obs.dump_trace(str(path))
+    assert n == 3
+    doc = json.loads(path.read_text())
+    assert isinstance(doc['traceEvents'], list)
+    assert doc['traceEvents'][0]['ph'] == 'M'      # process_name metadata
+    assert {e['ph'] for e in doc['traceEvents'][1:]} == {'X', 'i'}
+
+
+def test_span_records_error_and_reraises():
+    with pytest.raises(RuntimeError):
+        with obs.span('t.boom'):
+            raise RuntimeError('no')
+    ev = obs.trace_events()[-1]
+    assert ev['name'] == 't.boom'
+    assert 'RuntimeError' in ev['args']['error']
+
+
+def test_span_degrades_without_trace_annotation(monkeypatch):
+    from paddle_tpu.observability import trace as trace_mod
+    mod = trace_mod._jax_profiler()
+    if mod is not None:
+        monkeypatch.setattr(mod, 'TraceAnnotation',
+                            None, raising=False)
+    with obs.span('t.deg') as sp:
+        time.sleep(0.001)
+    assert sp.duration > 0                         # host timing still works
+    assert obs.trace_events()[-1]['name'] == 't.deg'
+
+
+# ---- disabled mode ---------------------------------------------------------
+
+def test_disabled_mode_returns_shared_singletons():
+    obs.set_enabled(False)
+    assert obs.counter('a') is NULL_METRIC
+    assert obs.counter('b', {'x': '1'}) is NULL_METRIC
+    assert obs.gauge('c') is NULL_METRIC
+    assert obs.histogram('d') is NULL_METRIC
+    assert obs.span('e') is NULL_SPAN
+    assert obs.span('f', k=1) is NULL_SPAN
+    with obs.span('g') as sp:
+        sp.event('x')
+    NULL_METRIC.inc()
+    NULL_METRIC.observe(1.0)
+    NULL_METRIC.set(2)
+    obs.record_event('h')
+    assert obs.snapshot()['counters'] == {}
+    assert obs.trace_events() == []
+
+
+def test_disabled_mode_env_knob():
+    import subprocess
+    import sys
+    code = ('import paddle_tpu.observability as o; '
+            'assert not o.enabled(); '
+            'assert o.counter("x") is o.NULL_METRIC; print("ok")')
+    p = subprocess.run([sys.executable, '-c', code],
+                       capture_output=True, text=True,
+                       env={**__import__("os").environ,
+                            'PADDLE_TPU_OBS': '0', 'JAX_PLATFORMS': 'cpu'})
+    assert p.returncode == 0 and 'ok' in p.stdout, p.stderr
+
+
+# ---- RecordEvent hardening -------------------------------------------------
+
+def test_record_event_misuse_is_noop():
+    from paddle_tpu.profiler import RecordEvent
+    r = RecordEvent('t.re')
+    r.end()                  # end before begin: no-op, no AttributeError
+    r.begin()
+    r.begin()                # double begin: no leaked second annotation
+    r.end()
+    r.end()                  # double end: no-op
+    assert [e['name'] for e in obs.trace_events()] == ['t.re']
+
+
+def test_record_event_degrades_without_annotation(monkeypatch):
+    from paddle_tpu.observability import trace as trace_mod
+    from paddle_tpu.profiler import RecordEvent
+
+    class _Boom:
+        def __init__(self, name):
+            raise OSError('profiler backend gone')
+
+    mod = trace_mod._jax_profiler()
+    if mod is not None:
+        monkeypatch.setattr(mod, 'TraceAnnotation', _Boom, raising=False)
+    with RecordEvent('t.re2'):
+        pass
+    assert obs.trace_events()[-1]['name'] == 't.re2'
+
+
+# ---- views report registry numbers ----------------------------------------
+
+def test_step_timer_matches_registry():
+    from paddle_tpu.profiler import StepTimer
+    t = StepTimer()
+    for _ in range(5):
+        t.add('data', 0.002)
+        t.add('dispatch', 0.001)
+        t.step_done()
+    s = t.summary()
+    assert s['steps'] == 5
+    snap = obs.snapshot()
+    lbl = t.labels['timer']
+    assert snap['counters'][f'train.timer_steps{{timer={lbl}}}'] == 5
+    for phase in ('data', 'dispatch', 'readback'):
+        st = snap['histograms'][f'train.{phase}_ms{{timer={lbl}}}']
+        assert st['count'] == 5
+        assert abs(st['mean'] - s[f'{phase}_ms_mean']) < 1e-6
+        assert st['p50'] == s[f'{phase}_ms_p50']
+        assert st['p99'] == s[f'{phase}_ms_p99']
+
+
+def test_step_timer_works_disabled():
+    obs.set_enabled(False)
+    from paddle_tpu.profiler import StepTimer
+    t = StepTimer()
+    with t.span('data'):
+        time.sleep(0.001)
+    t.step_done()
+    s = t.summary()
+    assert s['steps'] == 1 and s['data_ms_mean'] > 0
+    assert obs.snapshot()['counters'] == {}    # nothing leaked globally
+
+
+def test_serving_stats_match_registry():
+    from paddle_tpu.serving.metrics import ServingStats
+    st = ServingStats()
+    st.note_submitted(3)
+    st.note_queue_wait(0.004)
+    st.note_completed(0.01)
+    st.note_completed(0.02)
+    st.note_failed()
+    st.note_batch(rows=6, bucket=8, exec_s=0.005)
+    snap_local = st.snapshot()
+    reg = obs.snapshot()
+    lbl = st.labels['engine']
+    assert snap_local['submitted'] == reg['counters'][
+        f'serve.requests_submitted{{engine={lbl}}}'] == 3
+    assert snap_local['completed'] == reg['counters'][
+        f'serve.requests_completed{{engine={lbl}}}'] == 2
+    assert snap_local['failed'] == reg['counters'][
+        f'serve.requests_failed{{engine={lbl}}}'] == 1
+    assert snap_local['rows'] == 6 and snap_local['padded_rows'] == 8
+    h = reg['histograms'][f'serve.latency_ms{{engine={lbl}}}']
+    assert h['count'] == 2
+    assert snap_local['latency_ms_p99'] == round(h['p99'], 3)
+
+
+def test_serving_stats_work_disabled():
+    obs.set_enabled(False)
+    from paddle_tpu.serving.metrics import ServingStats
+    st = ServingStats()
+    st.note_submitted()
+    st.note_completed(0.01)
+    st.note_batch(rows=4, bucket=4, exec_s=0.001)
+    s = st.snapshot()
+    assert s['submitted'] == 1 and s['completed'] == 1
+    assert s['batch_occupancy'] == 1.0
+    assert s['latency_ms_p50'] == 10.0
+    assert obs.snapshot()['counters'] == {}
+
+
+# ---- fault / ckpt wiring ---------------------------------------------------
+
+def test_retry_emits_counters_and_events():
+    from paddle_tpu.fault import RetryError, retry
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise ValueError('transient')
+        return 'ok'
+
+    assert retry(flaky, retries=5, sleep=lambda s: None) == 'ok'
+    snap = obs.snapshot()
+    assert snap['counters']['fault.retry_calls'] == 1
+    assert snap['counters']['fault.retries'] == 2
+    with pytest.raises(RetryError):
+        retry(lambda: 1 / 0, retries=2, sleep=lambda s: None)
+    snap = obs.snapshot()
+    assert snap['counters']['fault.retry_exhausted'] == 1
+    retry_events = [e for e in obs.trace_events()
+                    if e['name'] == 'fault.retry']
+    assert len(retry_events) == 3      # 2 from flaky + 1 from the failure
+    assert retry_events[0]['args']['attempt'] == 1
+
+
+def test_circuit_breaker_gauge_and_transitions():
+    from paddle_tpu.fault import CircuitBreaker, CircuitOpenError
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, recovery_timeout=10.0,
+                        clock=lambda: now[0])
+    lbl = br.labels['breaker']
+    key = f'fault.circuit_state{{breaker={lbl}}}'
+    assert obs.snapshot()['gauges'][key] == 0     # closed, published at init
+    for _ in range(2):
+        with pytest.raises(ZeroDivisionError):
+            br.call(lambda: 1 / 0)
+    assert obs.snapshot()['gauges'][key] == 1     # open
+    assert obs.snapshot()['counters']['fault.circuit_opened'] == 1
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: 'x')
+    now[0] = 11.0
+    assert br.call(lambda: 'x') == 'x'            # half-open trial -> closed
+    assert obs.snapshot()['gauges'][key] == 0
+    trans = [e['args'] for e in obs.trace_events()
+             if e['name'] == 'fault.circuit_transition']
+    assert [(t['frm'], t['to']) for t in trans] == [
+        ('closed', 'open'), ('open', 'half_open'), ('half_open', 'closed')]
+
+
+def test_inject_counts_fired_faults():
+    from paddle_tpu import fault
+    from paddle_tpu.fault import InjectedFault
+    fault.configure('t.point:1.0', seed=0)
+    try:
+        with pytest.raises(InjectedFault):
+            fault.inject('t.point')
+    finally:
+        fault.configure(None)
+    snap = obs.snapshot()
+    assert snap['counters']['fault.injected{point=t.point}'] == 1
+
+
+def test_checkpoint_save_load_metrics(tmp_path):
+    import paddle_tpu.framework_io as fio
+    p = str(tmp_path / 'm.pdparams')
+    fio.save({'w': np.arange(6, dtype='float32')}, p)
+    out = fio.load(p)
+    assert np.allclose(out['w'], np.arange(6))
+    snap = obs.snapshot()
+    assert snap['counters']['ckpt.saves'] == 1
+    assert snap['counters']['ckpt.loads'] == 1
+    assert snap['counters']['ckpt.bytes_written'] > 0
+    assert snap['histograms']['ckpt.save_ms']['count'] == 1
+    names = [e['name'] for e in obs.trace_events()]
+    assert 'ckpt.save' in names and 'ckpt.load' in names
+
+
+# ---- end-to-end ------------------------------------------------------------
+
+class _ToyDS(paddle.io.Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        return (rng.randn(8).astype('float32'),
+                np.array([i % 2], dtype='int64'))
+
+
+def _toy_model():
+    from paddle_tpu import nn
+    from paddle_tpu.hapi.model import Model
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net)
+    m.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return m, net
+
+
+def test_fit_plus_engine_snapshot_has_all_namespaces(tmp_path):
+    m, net = _toy_model()
+    m.fit(_ToyDS(), batch_size=8, epochs=1, verbose=0)
+
+    from paddle_tpu.serving import InferenceEngine
+    eng = InferenceEngine(net, max_batch_size=8, max_delay_ms=1)
+    futs = [eng.submit(np.random.randn(1, 8).astype('float32'))
+            for _ in range(4)]
+    for f in futs:
+        f.result(timeout=30)
+    eng.shutdown()
+
+    snap = obs.snapshot()
+    keys = (list(snap['counters']) + list(snap['gauges'])
+            + list(snap['histograms']))
+    for ns in ('train.', 'serve.', 'fault.', 'data.'):
+        assert any(k.startswith(ns) for k in keys), f'missing {ns}: {keys}'
+    assert snap['counters']['train.steps'] == 4
+    assert snap['counters']['train.epochs'] == 1
+
+    # the exported trace is valid Chrome trace-event JSON
+    path = tmp_path / 'trace.json'
+    obs.dump_trace(str(path))
+    doc = json.loads(path.read_text())
+    assert isinstance(doc['traceEvents'], list) and doc['traceEvents']
+    for ev in doc['traceEvents']:
+        assert 'name' in ev and 'ph' in ev and 'pid' in ev
+        if ev['ph'] == 'X':
+            assert 'ts' in ev and 'dur' in ev
+    names = {e['name'] for e in doc['traceEvents']}
+    assert {'train.fit', 'train.step', 'serve.batch'} <= names
+
+
+def test_metrics_exporter_callback(tmp_path):
+    from paddle_tpu.hapi.callbacks import MetricsExporter
+    m, _ = _toy_model()
+    log_dir = tmp_path / 'obs'
+    m.fit(_ToyDS(), batch_size=8, epochs=2, verbose=0,
+          callbacks=[MetricsExporter(log_dir=str(log_dir))])
+    lines = (log_dir / 'snapshots.jsonl').read_text().strip().splitlines()
+    assert len(lines) == 2                       # one per epoch
+    assert json.loads(lines[0])['epoch'] == 0
+    snap = json.loads((log_dir / 'snapshot.json').read_text())
+    assert 'train.steps' in snap['counters']
+    assert (log_dir / 'metrics.prom').exists()
+    assert (log_dir / 'trace.json').exists()
+
+
+def test_obs_dump_and_report(tmp_path):
+    obs.counter('train.steps').inc(3)
+    obs.histogram('serve.latency_ms').observe(5.0)
+    with obs.span('train.step', step=0):
+        pass
+    paths = obs.dump(str(tmp_path / 'd'))
+    assert set(paths) == {'snapshot', 'prometheus', 'trace'}
+    import sys
+    sys.path.insert(0, 'tools')
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    snap, trace = obs_report._load(str(tmp_path / 'd'))
+    report = obs_report.build_report(snap, trace)
+    assert 'train' in report['namespaces']
+    assert 'serve' in report['namespaces']
+    text = obs_report.render_text(report)
+    assert 'train.steps' in text and 'serve.latency_ms' in text

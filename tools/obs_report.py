@@ -1,0 +1,149 @@
+"""One-page run report from an observability dump.
+
+Renders the ``snapshot.json`` (+ optional ``trace.json``) produced by
+``observability.dump(dir)`` / ``PADDLE_TPU_OBS_DUMP=dir`` into a compact
+human-readable summary: per-namespace counters, gauge values, histogram
+latency tables (count / mean / p50 / p90 / p99), and — when a trace is
+present — the top span names by total self time.
+
+Run:  python tools/obs_report.py <dump_dir | snapshot.json> [--json]
+
+``--json`` emits the aggregated report as JSON instead of text (for CI
+artifacts). Exits nonzero if the dump cannot be read.
+"""
+import argparse
+import collections
+import json
+import os
+import sys
+
+NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data')
+
+
+def _load(path):
+    """Accept a dump directory or a snapshot.json path; returns
+    (snapshot, trace_doc_or_None)."""
+    if os.path.isdir(path):
+        snap_path = os.path.join(path, 'snapshot.json')
+        trace_path = os.path.join(path, 'trace.json')
+    else:
+        snap_path = path
+        trace_path = os.path.join(os.path.dirname(path) or '.', 'trace.json')
+    with open(snap_path) as f:
+        snap = json.load(f)
+    trace = None
+    if os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                trace = json.load(f)
+        except (OSError, ValueError):
+            trace = None
+    return snap, trace
+
+
+def _namespace(key):
+    base = key.split('{', 1)[0]
+    ns = base.split('.', 1)[0]
+    return ns if ns in NAMESPACES else 'other'
+
+
+def _group(section):
+    out = collections.defaultdict(dict)
+    for key, val in sorted(section.items()):
+        out[_namespace(key)][key] = val
+    return out
+
+
+def _fmt_num(v):
+    if v is None:
+        return '-'
+    if isinstance(v, float):
+        return f'{v:.3f}'.rstrip('0').rstrip('.') or '0'
+    return str(v)
+
+
+def _span_totals(trace):
+    """Total duration (ms) and count per span name from complete events."""
+    totals = collections.defaultdict(lambda: [0.0, 0])
+    for ev in trace.get('traceEvents', []):
+        if ev.get('ph') != 'X':
+            continue
+        t = totals[ev.get('name', '?')]
+        t[0] += ev.get('dur', 0.0) / 1e3
+        t[1] += 1
+    return sorted(((name, ms, n) for name, (ms, n) in totals.items()),
+                  key=lambda x: -x[1])
+
+
+def build_report(snap, trace=None):
+    report = {'ts': snap.get('ts'), 'namespaces': {}}
+    counters = _group(snap.get('counters', {}))
+    gauges = _group(snap.get('gauges', {}))
+    hists = _group(snap.get('histograms', {}))
+    for ns in list(NAMESPACES) + ['other']:
+        block = {}
+        if ns in counters:
+            block['counters'] = counters[ns]
+        if ns in gauges:
+            block['gauges'] = gauges[ns]
+        if ns in hists:
+            block['histograms'] = hists[ns]
+        if block:
+            report['namespaces'][ns] = block
+    if trace is not None:
+        report['spans'] = [
+            {'name': name, 'total_ms': round(ms, 3), 'count': n}
+            for name, ms, n in _span_totals(trace)[:15]]
+    return report
+
+
+def render_text(report):
+    lines = ['paddle_tpu run report', '=' * 60]
+    for ns, block in report['namespaces'].items():
+        lines.append(f'\n[{ns}]')
+        for key, val in block.get('counters', {}).items():
+            lines.append(f'  {key:<46} {_fmt_num(val)}')
+        for key, val in block.get('gauges', {}).items():
+            lines.append(f'  {key:<46} {_fmt_num(val)} (gauge)')
+        h = block.get('histograms')
+        if h:
+            lines.append(f'  {"histogram":<34} {"count":>7} {"mean":>9} '
+                         f'{"p50":>9} {"p90":>9} {"p99":>9}')
+            for key, st in h.items():
+                lines.append(
+                    f'  {key:<34} {st.get("count", 0):>7} '
+                    f'{_fmt_num(st.get("mean")):>9} '
+                    f'{_fmt_num(st.get("p50")):>9} '
+                    f'{_fmt_num(st.get("p90")):>9} '
+                    f'{_fmt_num(st.get("p99")):>9}')
+    if report.get('spans'):
+        lines.append('\n[spans] top by total time')
+        lines.append(f'  {"name":<34} {"total_ms":>10} {"count":>7}')
+        for s in report['spans']:
+            lines.append(f'  {s["name"]:<34} {_fmt_num(s["total_ms"]):>10} '
+                         f'{s["count"]:>7}')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('path', help='dump directory or snapshot.json')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the aggregated report as JSON')
+    args = ap.parse_args(argv)
+    try:
+        snap, trace = _load(args.path)
+    except (OSError, ValueError) as e:
+        print(f'obs_report: cannot read dump at {args.path!r}: {e}',
+              file=sys.stderr)
+        return 2
+    report = build_report(snap, trace)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
