@@ -402,6 +402,19 @@ def _child_serving():
     print(json.dumps(serve_bench.run_bench(requests=160)))
 
 
+def _child_warmup():
+    """Cold-start row: time-to-first-response of a fresh serving process,
+    unwarmed vs warmed via manifest prebuild + persistent compile cache
+    (the tools/warmup_check.py measurement; each arm is itself a fresh
+    subprocess, so this child only orchestrates)."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import warmup_check
+    print(json.dumps(warmup_check.run_check()))
+
+
 def _child_obs_overhead():
     """Observability overhead probe: steps/s of a small hapi fit loop, run
     by the parent twice (PADDLE_TPU_OBS=0 and =1) so the <5% budget of the
@@ -787,6 +800,17 @@ def main(fast=False):
         else:
             print(f'serving bench failed: {snote}', file=sys.stderr)
 
+        wc, wnote = _run_child(['--child-warmup'], PREDICTOR_TIMEOUT_S)
+        if wc is not None:
+            out['cold_start_first_request_ms'] = wc['cold_ms']
+            out['cold_start_warmed_ms'] = wc['warm_ms']
+            out['cold_start_speedup'] = wc['speedup']
+            out['cold_start_executables_prebuilt'] = wc['executables_prebuilt']
+            out['cold_start_compiles_after_warm'] = wc['compiles_after_warm']
+            out['cold_start_ok'] = wc['ok']
+        else:
+            print(f'warmup check failed: {wnote}', file=sys.stderr)
+
         eager, enote = _run_child(['--child-eager'], 180)
         if eager is not None:
             out['eager_ops_per_sec'] = round(eager['eager_ops_per_sec'], 1)
@@ -883,6 +907,8 @@ if __name__ == '__main__':
         _child_decode()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-serving':
         _child_serving()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-warmup':
+        _child_warmup()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':
         _child_obs_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':

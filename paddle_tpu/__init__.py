@@ -75,6 +75,6 @@ def __getattr__(name):
                 'models', 'ops', 'hapi', 'incubate', 'utils', 'profiler',
                 'hub', 'onnx', 'parallel', 'fluid', 'dataset', 'reader',
                 'sparsity', 'quantization', 'cost_model', 'fault',
-                'serving', 'observability'):
+                'serving', 'observability', 'warmup'):
         return importlib.import_module(f'.{name}', __name__)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

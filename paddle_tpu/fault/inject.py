@@ -9,10 +9,12 @@ Armed via ``PADDLE_FAULT_INJECT="point:prob[:action],..."`` where action is
 Instrumented points: ``ckpt.write`` / ``ckpt.commit`` (framework_io.save,
 before the payload / manifest os.replace), ``dataloader.step`` (per batch),
 ``collective.entry`` (all_reduce/all_gather/broadcast/barrier),
-``store.heartbeat`` (elastic membership beat), and ``serving.dispatch``
+``store.heartbeat`` (elastic membership beat), ``serving.dispatch``
 (serving.InferenceEngine, entry of every batched device call — inside the
 engine's CircuitBreaker, so armed faults exercise the breaker-opening
-path).
+path), and ``warmup.cache`` (warmup.enable_persistent_cache, inside the
+retried directory probe — armed faults exercise the fall-back-to-cold-
+compiles path).
 
 When no spec is armed, ``inject()`` is a single falsy-dict check — zero cost
 on hot paths.

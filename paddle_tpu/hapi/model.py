@@ -17,6 +17,7 @@ fully synchronous per-step behavior.
 import collections
 import math
 import os
+import sys
 import time
 
 import jax
@@ -87,7 +88,8 @@ class Model:
         self.stop_training = False
 
     # ---- setup -----------------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, warmup=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -96,6 +98,19 @@ class Model:
         self._train_steps = {}
         self._eval_steps = {}
         self._opt_init_pending = True
+        if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
+            from .. import warmup as _warmup_mod
+            _warmup_mod.ensure_persistent_cache()
+        if warmup is not None:
+            self.prebuild_warmup(warmup)
+
+    def prebuild_warmup(self, manifest):
+        """AOT-prebuild the train/eval step signatures recorded in a warmup
+        manifest (a ``warmup.Manifest`` or a path): the first real batch
+        then runs an already-compiled program. Returns the prebuild
+        report. Also reachable as ``prepare(warmup=)`` / ``fit(warmup=)``."""
+        from .. import warmup as _warmup_mod
+        return _warmup_mod.prebuild(manifest, model=self)
 
     # ---- functional plumbing --------------------------------------------
     def _pack(self):
@@ -427,6 +442,11 @@ class Model:
         self._opt_init_pending = False
         inputs = [self._as_device(t) for t in _to_list(inputs)]
         labels = [self._as_device(t) for t in _to_list(labels)]
+        wm = sys.modules.get('paddle_tpu.warmup.manifest')
+        if wm is not None and wm.capturing():
+            wm.record(wm.train_step_entry(
+                wm.array_sig(inputs), wm.array_sig(labels),
+                accumulate=(not update) or self._grad_acc is not None))
         lr = self._lr_scalar()
         key = next_key()
         if not update:
@@ -495,6 +515,9 @@ class Model:
             step = self._build_eval_step()
             self._eval_steps[key] = step
         self._eval_step = step
+        wm = sys.modules.get('paddle_tpu.warmup.manifest')
+        if wm is not None and wm.capturing():
+            wm.record(wm.eval_step_entry(key[1], key[2]))
         if self._tstate is not None:
             ts = self._ensure_tstate()
             params, buffers = ts.params, ts.buffers
@@ -521,9 +544,15 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, resume=None):
+            accumulate_grad_batches=1, num_iters=None, resume=None,
+            warmup=None):
         from .callbacks import (AutoResume, CallbackList, ModelCheckpoint,
                                 ProgBarLogger)
+        if warmup is not None:
+            # compile the recorded step signatures before the first batch so
+            # step 0 runs at steady-state latency (and hits the persistent
+            # cache when enabled)
+            self.prebuild_warmup(warmup)
         loader = self._as_loader(train_data, batch_size, shuffle)
         eval_loader = self._as_loader(eval_data, batch_size, False)
         callbacks = list(callbacks or [])

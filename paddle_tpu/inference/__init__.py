@@ -225,6 +225,11 @@ class Predictor:
                         and f.shape[0] == n_rows else f
                         for f in feed]
             key = tuple((tuple(f.shape), str(f.dtype)) for f in feed)
+            import sys as _sys
+            wm = _sys.modules.get('paddle_tpu.warmup.manifest')
+            if wm is not None and wm.capturing():
+                wm.record(wm.predictor_entry(
+                    key, precision=str(self.config._precision)))
             out = self._get_compiled(key)(*feed)
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs = [np.asarray(o) for o in outs]
@@ -238,6 +243,18 @@ class Predictor:
         self._output_names = [f'out{i}' for i in range(len(outs))]
         self._results = dict(zip(self._output_names, outs))
         return outs
+
+    def warmup(self, manifest):
+        """AOT-prebuild the ``run()`` feed signatures recorded in a warmup
+        manifest — the modern form of Paddle's "run once with dummy data"
+        Predictor warmup idiom, except no data is needed at all. Requires
+        an attached Layer (exported programs have pinned shapes and nothing
+        to prebuild). Returns the prebuild report."""
+        if self._layer is None:
+            raise RuntimeError('warmup needs an attached Layer; the exported '
+                               'program is already a single executable')
+        from .. import warmup as _warmup_mod
+        return _warmup_mod.prebuild(manifest, predictor=self)
 
 
 def create_predictor(config):

@@ -19,6 +19,7 @@ Env knobs: ``PADDLE_TPU_SERVE_MAX_BATCH`` (default 16),
 ``PADDLE_TPU_SERVE_MAX_DELAY_MS`` (default 2.0).
 """
 import os
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -57,8 +58,11 @@ def _env_float(name, default):
 
 def _resolve_backend(net, precision):
     """Accepts a Layer, a hapi Model, or an inference Predictor and returns
-    (layer, params, buffers, precision)."""
+    (layer, params, buffers, precision, example_spec) where example_spec is
+    the backend's declared input spec (hapi InputSpecs / Predictor meta) for
+    ``warmup='all_buckets'``, or None when the backend declares none."""
     from ..nn.layer_base import Layer, buffer_arrays, param_arrays
+    example_spec = None
     if not isinstance(net, Layer) and \
             isinstance(getattr(net, 'network', None), Layer):
         # hapi Model: flush the async executor's device-resident state back
@@ -70,10 +74,12 @@ def _resolve_backend(net, precision):
         # train_batch's _enter_mode(True) a no-op (training silently
         # continuing with dropout off / BN frozen)
         net._enter_mode(False)
+        example_spec = list(net._inputs) if getattr(net, '_inputs', None) \
+            else None
         net = net.network
     if isinstance(net, Layer):
         return (net, param_arrays(net), buffer_arrays(net),
-                precision or 'float32')
+                precision or 'float32', example_spec)
     if hasattr(net, 'attach_layer') and hasattr(net, 'config'):
         # inference.Predictor
         pred = net
@@ -90,7 +96,8 @@ def _resolve_backend(net, precision):
                 precision = stored   # offline-converted model: honor it
         params = {k: jnp.asarray(v) for k, v in pred._params.items()}
         buffers = {k: jnp.asarray(v) for k, v in pred._buffers.items()}
-        return layer, params, buffers, precision or 'float32'
+        example_spec = pred._meta.get('input_spec') or None
+        return layer, params, buffers, precision or 'float32', example_spec
     raise TypeError(f'cannot serve a {type(net).__name__}; expected a '
                     f'Layer, hapi Model, or inference Predictor')
 
@@ -107,8 +114,13 @@ class InferenceEngine:
 
     def __init__(self, net=None, *, max_batch_size=None, max_delay_ms=None,
                  queue_capacity=256, precision=None, default_deadline_ms=None,
-                 breaker=None, autostart=True, clock=None):
-        layer, params, buffers, precision = _resolve_backend(net, precision)
+                 breaker=None, autostart=True, clock=None, warmup=None,
+                 input_spec=None):
+        if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
+            from .. import warmup as _warmup_mod
+            _warmup_mod.ensure_persistent_cache()
+        layer, params, buffers, precision, example_spec = \
+            _resolve_backend(net, precision)
         layer.eval()    # serving is per-sample: BN/dropout must be frozen
         self._layer = layer
         self._precision = precision
@@ -147,6 +159,12 @@ class InferenceEngine:
         self._thread = None
         self._closed = False
         self._draining = False
+        self._example_spec = input_spec if input_spec is not None \
+            else example_spec
+        if warmup is not None:
+            # precompile before submit() is ever accepted: the first real
+            # request must find its executable already in the bucket cache
+            self.warmup(warmup)
 
     # ---- compile path ----------------------------------------------------
     def _build(self, bucket, sig, precision):
@@ -165,7 +183,25 @@ class InferenceEngine:
                       for x in xs]
             out, _ = functional_call(layer, params, buffers, *xs)
             return out
+        wm = sys.modules.get('paddle_tpu.warmup.manifest')
+        if wm is not None and wm.capturing():
+            wm.record(wm.serving_bucket_entry(
+                bucket, sig, precision, max_batch=self.max_batch_size))
         return jax.jit(infer)
+
+    def warmup(self, manifest='all_buckets', input_spec=None):
+        """AOT-precompile serving executables before traffic.
+
+        ``manifest`` is a ``warmup.Manifest``, a path to a saved one, or
+        the string ``'all_buckets'`` to synthesize the whole bucket ladder
+        for one input signature (``input_spec=`` per-example
+        ``(shape, dtype)`` pairs, or the spec inferred from a hapi Model /
+        Predictor backend). Returns the prebuild report dict."""
+        from .. import warmup as _warmup_mod
+        if isinstance(manifest, str) and manifest == 'all_buckets':
+            manifest = _warmup_mod.all_buckets_manifest(
+                self, input_spec=input_spec)
+        return _warmup_mod.prebuild(manifest, engine=self)
 
     # ---- lifecycle -------------------------------------------------------
     def start(self):
@@ -357,6 +393,8 @@ class InferenceEngine:
         with self._lock:
             out['queue_depth'] = self._queues.depth
         out['compiles'] = len(self._cache)
+        out['cache_misses'] = self._cache.misses
+        out['prebuilt'] = self._cache.prebuilt
         out['traces'] = self._trace_count
         out['buckets'] = list(bucket_sizes(self.max_batch_size))
         out['max_batch_size'] = self.max_batch_size

@@ -1,0 +1,53 @@
+"""paddle_tpu.warmup — persistent compile cache + AOT warmup manifests.
+
+Kills cold start on both ends of the lifecycle:
+
+- **Persistent compile cache** (``persistent.py``): one switch points
+  JAX's on-disk compilation cache at a framework-version+backend-keyed
+  directory, with corruption-tolerant fallback and ``warmup.cache.*``
+  hit/miss/bytes telemetry.
+- **Warmup manifests** (``manifest.py``): ``capture()`` records every
+  distinct compiled signature of a run — serving bucket keys, hapi
+  train/eval step signatures, Predictor feed keys — into a JSON manifest.
+- **AOT prebuild** (``prebuild.py``): ``prebuild(manifest, ...)`` replays
+  the manifest with abstract ``ShapeDtypeStruct`` args ahead of traffic,
+  populating the in-process caches and the persistent cache.
+
+Recipe::
+
+    from paddle_tpu import warmup, serving
+
+    warmup.enable_persistent_cache('/var/cache/paddle_tpu')
+
+    # capture run (once, e.g. in staging)
+    with warmup.capture() as man:
+        engine = serving.InferenceEngine(net, max_batch_size=64)
+        ... live or synthetic traffic ...
+    man.save('warmup.json')
+
+    # every later process: first request runs an already-built program
+    engine = serving.InferenceEngine(net, max_batch_size=64,
+                                     warmup='warmup.json')
+
+Env knob: ``PADDLE_TPU_COMPILE_CACHE=<dir>`` enables the persistent cache
+without code changes (picked up by the serving engine and hapi Model).
+"""
+from .manifest import (Manifest, array_sig, capture, capture_start,  # noqa: F401
+                       capture_stop, capturing, eval_step_entry,
+                       predictor_entry, record, serving_bucket_entry,
+                       train_step_entry)
+from .persistent import (ENV_CACHE_DIR, cache_key_component,  # noqa: F401
+                         cache_stats, disable_persistent_cache,
+                         enable_persistent_cache, ensure_persistent_cache,
+                         persistent_cache_dir)
+from .prebuild import all_buckets_manifest, prebuild  # noqa: F401
+
+__all__ = [
+    'Manifest', 'capture', 'capture_start', 'capture_stop', 'capturing',
+    'record', 'array_sig', 'serving_bucket_entry', 'train_step_entry',
+    'eval_step_entry', 'predictor_entry',
+    'enable_persistent_cache', 'disable_persistent_cache',
+    'ensure_persistent_cache', 'persistent_cache_dir', 'cache_stats',
+    'cache_key_component', 'ENV_CACHE_DIR',
+    'prebuild', 'all_buckets_manifest',
+]

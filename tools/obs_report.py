@@ -17,7 +17,7 @@ import json
 import os
 import sys
 
-NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data')
+NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data', 'warmup')
 
 
 def _load(path):

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Cold-start check: a warmed fresh process must serve its first request
+with ZERO new bucket compiles and materially lower time-to-first-response.
+
+Two fresh subprocesses over the same model architecture share a tmp dir:
+
+  1. ``cold``: persistent cache + ``warmup.capture()`` around a cold
+     serving engine driven across the whole bucket ladder — measures the
+     unwarmed first-request latency, saves the manifest, and populates the
+     on-disk compile cache.
+  2. ``warm``: a brand-new process enables the same persistent cache and
+     constructs the engine with ``warmup=<manifest>`` — every executable is
+     AOT-prebuilt before ``submit()`` is accepted. Measures the warmed
+     first-request latency and counts bucket-cache misses during live
+     traffic (must be 0).
+
+Prints ONE json line::
+
+  {"cold_ms": ..., "warm_ms": ..., "executables_prebuilt": ...,
+   "compiles_after_warm": 0, "speedup": ..., "prebuild_ms": ...,
+   "cache_entries": ..., "cache_bytes": ..., "ok": true}
+
+Exit code 0 iff ``compiles_after_warm == 0`` and ``warm_ms < cold_ms``.
+
+Usage: python tools/warmup_check.py [--max-batch B] [--keep-dir DIR]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+IN_DIM, HIDDEN, OUT_DIM = 64, 256, 32
+
+
+def _make_net():
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(IN_DIM, HIDDEN), nn.ReLU(),
+                        nn.Linear(HIDDEN, HIDDEN), nn.ReLU(),
+                        nn.Linear(HIDDEN, OUT_DIM))
+    net.eval()
+    return net
+
+
+def _traffic(max_batch, seed=0):
+    """First request + a follow-up stream covering every bucket."""
+    rng = np.random.RandomState(seed)
+    first = rng.rand(3, IN_DIM).astype('float32')
+    sizes = [1, 2, 4, 5, max_batch, max_batch - 1, 3]
+    rest = [rng.rand(min(s, max_batch), IN_DIM).astype('float32')
+            for s in sizes]
+    return first, rest
+
+
+def _child(mode, tmp, max_batch):
+    from paddle_tpu import serving, warmup
+
+    warmup.enable_persistent_cache(os.path.join(tmp, 'cache'))
+    manifest_path = os.path.join(tmp, 'manifest.json')
+    first, rest = _traffic(max_batch)
+    net = _make_net()
+    out = {'mode': mode}
+
+    def drive(engine):
+        t0 = time.perf_counter()
+        engine.submit(first).result(timeout=300)
+        first_ms = 1e3 * (time.perf_counter() - t0)
+        for f in [engine.submit(r) for r in rest]:
+            f.result(timeout=300)
+        return first_ms
+
+    if mode == 'cold':
+        with warmup.capture() as manifest:
+            engine = serving.InferenceEngine(net, max_batch_size=max_batch,
+                                             max_delay_ms=0.5)
+            out['first_request_ms'] = drive(engine)
+            engine.shutdown()
+        manifest.save(manifest_path)
+        out['manifest_entries'] = len(manifest)
+    else:
+        t0 = time.perf_counter()
+        engine = serving.InferenceEngine(net, max_batch_size=max_batch,
+                                         max_delay_ms=0.5,
+                                         warmup=manifest_path)
+        out['prebuild_ms'] = 1e3 * (time.perf_counter() - t0)
+        out['executables_prebuilt'] = engine._cache.prebuilt
+        out['first_request_ms'] = drive(engine)
+        # bucket-cache misses == compiles triggered by live traffic
+        out['compiles_during_traffic'] = engine._cache.misses
+        engine.shutdown()
+    out['cache'] = warmup.cache_stats()
+    print(json.dumps(out))
+
+
+def _run_child(mode, tmp, max_batch, timeout=600):
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child', mode,
+         '--dir', tmp, '--max-batch', str(max_batch)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'{mode} child failed:\n{proc.stdout}\n'
+                           f'{proc.stderr}')
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_check(max_batch=8, work_dir=None, timeout=600):
+    """Run the cold/warm pair; returns the summary dict (importable from
+    bench.py and the test suite)."""
+    own_tmp = work_dir is None
+    tmp = work_dir or tempfile.mkdtemp(prefix='paddle_tpu_warmup_')
+    try:
+        cold = _run_child('cold', tmp, max_batch, timeout)
+        warm = _run_child('warm', tmp, max_batch, timeout)
+    finally:
+        if own_tmp:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    compiles_after_warm = warm['compiles_during_traffic']
+    return {
+        'cold_ms': round(cold['first_request_ms'], 3),
+        'warm_ms': round(warm['first_request_ms'], 3),
+        'executables_prebuilt': warm['executables_prebuilt'],
+        'compiles_after_warm': compiles_after_warm,
+        'prebuild_ms': round(warm['prebuild_ms'], 3),
+        'speedup': round(cold['first_request_ms']
+                         / max(warm['first_request_ms'], 1e-9), 2),
+        'manifest_entries': cold['manifest_entries'],
+        'cache_entries': warm['cache']['entries'],
+        'cache_bytes': warm['cache']['bytes'],
+        'cache_hit_total': warm['cache']['hit_total'],
+        'ok': bool(compiles_after_warm == 0
+                   and warm['first_request_ms'] < cold['first_request_ms']),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--max-batch', type=int, default=8)
+    ap.add_argument('--keep-dir', default=None,
+                    help='reuse/keep this work dir (default: fresh tmp)')
+    ap.add_argument('--child', choices=('cold', 'warm'), default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument('--dir', default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.child, args.dir, args.max_batch)
+        return 0
+    result = run_check(max_batch=args.max_batch, work_dir=args.keep_dir)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
