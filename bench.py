@@ -180,14 +180,30 @@ def _child_train(cfg):
     fence(loss, params, opt_state)
     dt = time.perf_counter() - t0
     final_loss = float(loss)
-    print(json.dumps({
+    out = {
         'tokens_per_sec': batch * seq * iters / dt,
         'steps_per_sec': iters / dt,
         'host_dispatch_ms_per_step': 1e3 * t_dispatch / iters,
         'loss': final_loss,
         'n_params': n_params,
         'platform': jax.devices()[0].platform,
-    }))
+    }
+    try:
+        # XLA's own static cost model for the exact executable just timed
+        # (lower/compile on the live args is a cache hit): the parent joins
+        # flops_per_step with steps_per_sec into mfu_cost_model so the
+        # analytic 6N MFU and the compiler's number are banked side by side
+        from paddle_tpu.observability import perf as _perf
+        rec = _perf.analyze('bench.train_step', step,
+                            (params, opt_state, key, lr, toks, toks))
+        if rec and rec['flops']:
+            out['flops_per_step'] = rec['flops']
+            out['bytes_per_step'] = rec['bytes_accessed']
+            out['arithmetic_intensity'] = rec['intensity']
+            out['bound_by'] = rec['bound_by']
+    except Exception:
+        pass
+    print(json.dumps(out))
 
 
 def _child_eager():
@@ -722,6 +738,14 @@ def main(fast=False):
     peak, gen_known = _peak_flops(platform)
     out['mfu'], out['mfu_attn_incl'] = _mfu_pair(
         tps, result['n_params'], out['config'], peak)
+    if result.get('flops_per_step'):
+        # compiler-counted FLOPs x measured steps/s against the SAME peak as
+        # the analytic column: the two MFU numbers differ only by what the
+        # 6N approximation miscounts (embeddings, attention, remat)
+        out['mfu_cost_model'] = round(
+            result['flops_per_step'] * result['steps_per_sec'] / peak, 4)
+        out['bound_by'] = result.get('bound_by')
+        out['arithmetic_intensity'] = result.get('arithmetic_intensity')
     # Sanity fence: mfu > 1 is physically impossible. When the TPU generation
     # is unknown, judge against the fastest known chip so a v5e default never
     # falsely condemns a legitimate number measured on newer hardware.
@@ -742,6 +766,9 @@ def main(fast=False):
         out['vs_baseline'] = 0.0
         out['mfu'] = 0.0
         out['mfu_attn_incl'] = 0.0
+        if 'mfu_cost_model' in out:
+            out['raw_mfu_cost_model'] = out['mfu_cost_model']
+            out['mfu_cost_model'] = 0.0
 
     if platform != 'cpu' and 'INVALID' not in out['metric'] and not fast:
         # ---- >=1B rung (VERDICT r5 item 1): GPT-3-1.3B-class config.

@@ -38,6 +38,17 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _perf_analyze(label, jitted, args):
+    """One-shot XLA cost/memory analysis of a compiled step (perf.* series).
+
+    Called right AFTER the live call with the same concrete args, so
+    ``lower().compile()`` inside is a pure executable-cache hit — no
+    retrace (donated/deleted buffers are fine, only avals are read). The
+    ``analyzed`` probe keeps steps 2+ at one dict lookup."""
+    if _obs.enabled() and _obs.perf.analyzed(label) is None:
+        _obs.perf.analyze(label, jitted, args)
+
+
 class _TrainState:
     """Device-resident training state: the single owner of the live
     param/buffer/opt-state arrays between compiled steps. ``mut_version``
@@ -455,29 +466,33 @@ class Model:
                 self._grad_acc = jax.tree_util.tree_map(jnp.zeros_like,
                                                         ts.params)
                 self._accum_count = 0
-            loss, out, new_b, self._grad_acc = self._accum_step(
-                ts.params, ts.buffers, self._grad_acc, key,
-                tuple(inputs), tuple(labels))
+            acc_args = (ts.params, ts.buffers, self._grad_acc, key,
+                        tuple(inputs), tuple(labels))
+            loss, out, new_b, self._grad_acc = self._accum_step(*acc_args)
+            _perf_analyze('hapi.accum_step', self._accum_step, acc_args)
             ts.buffers = new_b
             self._accum_count += 1
             self._last_outputs = out
             return self._finish_step(loss)
         if self._grad_acc is not None:
             # final micro step: accumulate then apply averaged grads
-            loss, out, new_b, self._grad_acc = self._accum_step(
-                ts.params, ts.buffers, self._grad_acc, key,
-                tuple(inputs), tuple(labels))
+            acc_args = (ts.params, ts.buffers, self._grad_acc, key,
+                        tuple(inputs), tuple(labels))
+            loss, out, new_b, self._grad_acc = self._accum_step(*acc_args)
+            _perf_analyze('hapi.accum_step', self._accum_step, acc_args)
             self._accum_count += 1
-            new_p, new_s = self._apply_accum(
-                ts.params, ts.opt_state, self._grad_acc, lr,
-                self._accum_scale(1.0 / self._accum_count))
+            apply_args = (ts.params, ts.opt_state, self._grad_acc, lr,
+                          self._accum_scale(1.0 / self._accum_count))
+            new_p, new_s = self._apply_accum(*apply_args)
+            _perf_analyze('hapi.apply_accum', self._apply_accum, apply_args)
             ts.params, ts.buffers, ts.opt_state = new_p, new_b, new_s
             self._grad_acc = None
             self._last_outputs = out
             return self._finish_step(loss)
-        loss, out, new_p, new_b, new_s = self._train_step(
-            ts.params, ts.buffers, ts.opt_state, key, lr,
-            tuple(inputs), tuple(labels))
+        step_args = (ts.params, ts.buffers, ts.opt_state, key, lr,
+                     tuple(inputs), tuple(labels))
+        loss, out, new_p, new_b, new_s = self._train_step(*step_args)
+        _perf_analyze('hapi.train_step', self._train_step, step_args)
         ts.params, ts.buffers, ts.opt_state = new_p, new_b, new_s
         self._last_outputs = out
         return self._finish_step(loss)
@@ -523,8 +538,10 @@ class Model:
             params, buffers = ts.params, ts.buffers
         else:
             params, buffers = self._params_dict(), self._buffers_dict()
-        loss, out = step(params, buffers, next_key(),
-                         tuple(inputs), tuple(labels))
+        eval_args = (params, buffers, next_key(),
+                     tuple(inputs), tuple(labels))
+        loss, out = step(*eval_args)
+        _perf_analyze('hapi.eval_step', step, eval_args)
         return ([np.asarray(loss)] if loss is not None else None,
                 out)
 
@@ -624,11 +641,20 @@ class Model:
                     do_update = (step_idx + 1) % accumulate_grad_batches == 0
                     if timer is not None:
                         t0 = time.perf_counter()
-                    with _obs.span('train.step', step=it_count) as sp:
-                        loss = self.train_batch(inputs, labels,
-                                                update=do_update)
+                    try:
+                        with _obs.span('train.step', step=it_count) as sp:
+                            loss = self.train_batch(inputs, labels,
+                                                    update=do_update)
+                    except BaseException:
+                        # a raising step must not book a partial duration
+                        # into the phase histograms (satellite: StepTimer
+                        # exception safety)
+                        if timer is not None:
+                            timer.abort_step()
+                        raise
                     step_ms.observe(1e3 * sp.duration)
                     step_counter.inc()
+                    _obs.perf.note_step('hapi.train_step', sp.duration)
                     if timer is not None:
                         timer.add('dispatch', time.perf_counter() - t0)
                     lval = loss[0]
@@ -641,6 +667,10 @@ class Model:
                         if timer is not None:
                             timer.add('readback', time.perf_counter() - t0)
                         loss_gauge.set(lval)
+                        if step_idx % log_freq == 0:
+                            # HBM sweep at log points only: live_arrays()
+                            # every sync step would blow the <5% obs budget
+                            _obs.perf.sweep_hbm()
                     logs = {'loss': lval, 'step': step_idx}
                     self._update_metrics(logs, inputs, labels)
                     cbks.on_batch_end('train', step_idx, logs)

@@ -230,7 +230,16 @@ class Predictor:
             if wm is not None and wm.capturing():
                 wm.record(wm.predictor_entry(
                     key, precision=str(self.config._precision)))
-            out = self._get_compiled(key)(*feed)
+            fn = self._get_compiled(key)
+            out = fn(*feed)
+            from .. import observability as _obs
+            if _obs.enabled():
+                label = 'predictor.' + ';'.join(
+                    'x'.join(map(str, f.shape)) or 'scalar' for f in feed)
+                if _obs.perf.analyzed(label) is None:
+                    # executable-cache hit (same concrete feed): publishes
+                    # perf.flops{fn}/hbm_bytes{fn,kind} for this feed key
+                    _obs.perf.analyze(label, fn, tuple(feed))
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs = [np.asarray(o) for o in outs]
         if bucket is not None and bucket != n_rows:

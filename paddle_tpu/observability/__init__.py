@@ -11,6 +11,8 @@ through it under a shared namespace:
 - ``fault.*`` — retries, circuit breakers, injected faults
 - ``ckpt.*``  — framework_io save/load, CheckpointManager save/restore
 - ``data.*``  — DataLoader batches, host collation, device prefetch
+- ``perf.*``  — XLA cost/memory analysis, MFU/roofline, HBM tracking
+- ``slo.*``   — SLO watcher breach counters and firing gauges
 
 Quick start::
 
@@ -34,11 +36,13 @@ import atexit
 import os
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
-                       NULL_METRIC, counter, enabled, fmt_key, gauge,
+                       NULL_METRIC, counter, enabled, find, fmt_key, gauge,
                        histogram, percentile, registry, set_enabled,
                        snapshot, to_prometheus)
 from .trace import (NULL_SPAN, Span, dump_trace, record_event,  # noqa: F401
                     reset_trace, span, trace_events)
+from . import perf  # noqa: F401  (perf.analyze / note_step / sweep_hbm)
+from . import slo   # noqa: F401  (slo.Watcher / slo.watcher())
 
 ENV_OBS = 'PADDLE_TPU_OBS'
 ENV_DUMP = 'PADDLE_TPU_OBS_DUMP'
@@ -47,16 +51,18 @@ __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'Span',
     'counter', 'gauge', 'histogram', 'registry', 'span', 'record_event',
     'snapshot', 'to_prometheus', 'trace_events', 'dump_trace', 'dump',
-    'enabled', 'set_enabled', 'reset', 'percentile',
+    'enabled', 'set_enabled', 'reset', 'percentile', 'find',
+    'perf', 'slo',
 ]
 
 
 def reset():
-    """Clear the default registry AND the trace ring (tests, run restarts).
-    Metric objects already held by views keep working but are no longer
-    exported until re-created."""
+    """Clear the default registry, the trace ring, AND the perf roofline
+    records (tests, run restarts). Metric objects already held by views
+    keep working but are no longer exported until re-created."""
     registry().reset()
     reset_trace()
+    perf.reset_perf()
 
 
 def dump(directory):

@@ -1,0 +1,305 @@
+"""Performance explainability: XLA cost/memory analysis, MFU, roofline.
+
+BENCH reports wall-time MFU but nothing attributes the gap to specific
+executables. This module joins XLA's own static cost model with measured
+step times into a per-executable roofline (arxiv 2104.05755's framing):
+
+- ``analyze(label, jitted, args)`` re-enters the AOT path
+  (``jitted.lower(*args).compile()`` — a cache hit after the first real
+  call, no retrace) and publishes ``compiled.cost_analysis()`` /
+  ``compiled.memory_analysis()`` as registry series: ``perf.flops{fn}``,
+  ``perf.bytes_accessed{fn}``, ``perf.arithmetic_intensity{fn}``,
+  ``perf.hbm_bytes{fn,kind}`` (kind: argument/output/temp/code), and a
+  compute-vs-memory-bound verdict against the device roofline ridge.
+- ``note_step(label, seconds)`` joins the static FLOPs with a measured
+  wall time into ``perf.mfu`` / ``perf.mfu{fn}`` and ``perf.step_ms{fn}``.
+- ``sweep_hbm()`` samples ``device.memory_stats()`` (falling back to
+  summing ``jax.live_arrays()`` on backends without an allocator stats
+  API, e.g. CPU) into ``perf.hbm_used_bytes{device}`` gauges, with a
+  cross-sweep growth detector that increments ``perf.hbm_leak_suspect``
+  after ``streak`` strictly-increasing sweeps.
+
+Peaks come from a per-device-kind table; ``PADDLE_TPU_PEAK_FLOPS`` /
+``PADDLE_TPU_PEAK_BW`` override both numbers for unlisted hardware (read
+per call so tests and long-lived processes can re-point them).
+
+Disabled mode (``PADDLE_TPU_OBS=0``): every entry point is a no-op
+returning ``None`` — no compile-cache touches, no registry families.
+"""
+import collections
+import os
+import threading
+
+from .registry import cfg, registry as _registry
+from .trace import record_event
+
+ENV_PEAK_FLOPS = 'PADDLE_TPU_PEAK_FLOPS'
+ENV_PEAK_BW = 'PADDLE_TPU_PEAK_BW'
+
+# (peak_flops/s, peak_HBM_bytes/s) by device-kind substring, checked in
+# order. FLOPs numbers match bench.py's PEAK_FLOPS; 'cpu' is nominal so
+# ratios stay comparable across runs, not a physical claim.
+PEAKS = (
+    ('v6e', (918e12, 1.64e12)),
+    ('v5p', (459e12, 2.76e12)),
+    ('v5e', (197e12, 0.82e12)),
+    ('v4', (275e12, 1.2e12)),
+    ('cpu', (1e12, 100e9)),
+)
+_DEFAULT_PEAKS = (197e12, 0.82e12)      # unknown accelerator: v5e numbers
+
+_lock = threading.Lock()
+_records = {}            # label -> roofline record dict
+_hbm_history = {}        # device key -> deque of recent used-bytes samples
+_mfu_handles = {}        # label -> (mfu_gauge, step_hist) hot-path cache
+
+_MEM_KINDS = (('argument', 'argument_size_in_bytes'),
+              ('output', 'output_size_in_bytes'),
+              ('temp', 'temp_size_in_bytes'),
+              ('code', 'generated_code_size_in_bytes'))
+
+
+_kind_cache = None
+
+
+def _device_kind():
+    # cached: jax.devices() per note_step() call is measurable against the
+    # obs-overhead budget, and the device set never changes in-process
+    global _kind_cache
+    if _kind_cache is None:
+        try:
+            import jax
+            _kind_cache = jax.devices()[0].device_kind.lower()
+        except Exception:
+            _kind_cache = 'unknown'
+    return _kind_cache
+
+
+def peaks(kind=None):
+    """-> ``(peak_flops_per_s, peak_bw_bytes_per_s, source)`` for a device
+    kind (default: device 0). Env overrides win over the table; source is
+    'env', 'table', or 'default'."""
+    env_f = os.environ.get(ENV_PEAK_FLOPS)
+    env_b = os.environ.get(ENV_PEAK_BW)
+    kind = (kind or _device_kind()).lower()
+    flops = bw = None
+    source = 'default'
+    for sub, (f, b) in PEAKS:
+        if sub in kind:
+            flops, bw, source = f, b, 'table'
+            break
+    if flops is None:
+        flops, bw = _DEFAULT_PEAKS
+    if env_f:
+        flops, source = float(env_f), 'env'
+    if env_b:
+        bw, source = float(env_b), 'env'
+    return flops, bw, source
+
+
+def _extract(compiled):
+    """Pull (flops, bytes_accessed, {kind: bytes}) out of a compiled
+    executable; cost_analysis() is a list-of-dicts on current jax."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get('flops', 0.0) or 0.0)
+    nbytes = float(ca.get('bytes accessed', 0.0) or 0.0)
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        for kind, attr in _MEM_KINDS:
+            mem[kind] = int(getattr(ma, attr, 0) or 0)
+    except Exception:
+        pass
+    return flops, nbytes, mem
+
+
+def analyze_compiled(label, compiled):
+    """Publish one compiled executable's static costs under ``fn=label``.
+    Returns the roofline record (also stored for ``note_step``/``report``)
+    or ``None`` when disabled / the runtime exposes no cost model."""
+    if not cfg.enabled:
+        return None
+    try:
+        flops, nbytes, mem = _extract(compiled)
+    except Exception:
+        _registry().counter('perf.analyze_errors', {'fn': label}).inc()
+        return None
+    peak_f, peak_bw, _ = peaks()
+    ridge = peak_f / peak_bw
+    intensity = flops / nbytes if nbytes else 0.0
+    bound_by = 'compute' if intensity >= ridge else 'memory'
+    lbl = {'fn': label}
+    reg = _registry()
+    reg.gauge('perf.flops', lbl).set(flops)
+    reg.gauge('perf.bytes_accessed', lbl).set(nbytes)
+    reg.gauge('perf.arithmetic_intensity', lbl).set(round(intensity, 4))
+    reg.gauge('perf.compute_bound', lbl).set(
+        1.0 if bound_by == 'compute' else 0.0)
+    for kind, v in mem.items():
+        reg.gauge('perf.hbm_bytes', {'fn': label, 'kind': kind}).set(v)
+    reg.gauge('perf.peak_flops').set(peak_f)
+    reg.gauge('perf.peak_bw').set(peak_bw)
+    reg.gauge('perf.ridge').set(round(ridge, 4))
+    rec = {'fn': label, 'flops': flops, 'bytes_accessed': nbytes,
+           'intensity': round(intensity, 4), 'bound_by': bound_by,
+           'hbm': mem, 'mfu': None, 'step_ms_p50': None}
+    with _lock:
+        _records[label] = rec
+        _mfu_handles.pop(label, None)
+    return rec
+
+
+def analyze(label, jitted, args=(), kwargs=None):
+    """Analyze a jitted callable at a signature it has already executed.
+
+    Passing the *same concrete arguments* as the live call guarantees
+    ``lower().compile()`` is a pure cache hit (no retrace, no recompile —
+    deleted/donated buffers are fine, only avals are read). Analysis
+    failures are counted (``perf.analyze_errors{fn}``), never raised into
+    the training/serving path.
+    """
+    if not cfg.enabled:
+        return None
+    try:
+        compiled = jitted.lower(*args, **(kwargs or {})).compile()
+    except Exception:
+        _registry().counter('perf.analyze_errors', {'fn': label}).inc()
+        return None
+    return analyze_compiled(label, compiled)
+
+
+def analyzed(label):
+    """The stored roofline record for ``label`` (or None) — cheap probe the
+    wiring sites use to analyze each executable exactly once."""
+    with _lock:
+        return _records.get(label)
+
+
+def note_step(label, seconds):
+    """Join a measured wall-time with ``label``'s static FLOPs: observes
+    ``perf.step_ms{fn}`` and sets ``perf.mfu{fn}`` + the headline
+    ``perf.mfu`` gauge. No-op (still timing-safe) before ``analyze``."""
+    if not cfg.enabled or seconds <= 0:
+        return None
+    with _lock:
+        rec = _records.get(label)
+        handles = _mfu_handles.get(label)
+    if rec is None:
+        return None
+    if handles is None:
+        reg = _registry()
+        lbl = {'fn': label}
+        handles = (reg.gauge('perf.mfu', lbl), reg.gauge('perf.mfu'),
+                   reg.histogram('perf.step_ms', lbl),
+                   reg.gauge('perf.achieved_flops', lbl))
+        with _lock:
+            _mfu_handles[label] = handles
+    mfu_g, mfu_top, step_h, ach_g = handles
+    peak_f, _, _ = peaks()
+    achieved = rec['flops'] / seconds
+    mfu = achieved / peak_f
+    step_h.observe(1e3 * seconds)
+    mfu_g.set(round(mfu, 6))
+    mfu_top.set(round(mfu, 6))
+    ach_g.set(achieved)
+    with _lock:
+        # p50 is NOT refreshed here: percentile() sorts the whole window,
+        # too expensive per step — report() computes it on demand
+        rec['mfu'] = round(mfu, 6)
+    return mfu
+
+
+def _live_bytes_by_device():
+    import jax
+    used = {}
+    for arr in jax.live_arrays():
+        try:
+            devs = list(arr.devices())
+            share = arr.nbytes // max(1, len(devs))
+            for d in devs:
+                used[d] = used.get(d, 0) + share
+        except Exception:
+            continue
+    return used
+
+
+def sweep_hbm(devices=None, streak=3):
+    """Sample per-device memory into ``perf.hbm_used_bytes{device}``.
+
+    Uses the allocator's ``memory_stats()['bytes_in_use']`` where the
+    backend provides it; otherwise (CPU) sums ``jax.live_arrays()``. A
+    device whose usage grows strictly for ``streak`` consecutive sweeps
+    increments ``perf.hbm_leak_suspect{device}`` and emits a trace event;
+    the history then resets so one leak fires once per streak, not every
+    subsequent sweep. Returns ``{device_key: used_bytes}``.
+    """
+    if not cfg.enabled:
+        return None
+    import jax
+    devices = list(devices) if devices is not None else jax.devices()
+    live = None
+    reg = _registry()
+    out = {}
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if stats and 'bytes_in_use' in stats:
+            used = int(stats['bytes_in_use'])
+        else:
+            if live is None:
+                live = _live_bytes_by_device()
+            used = int(live.get(d, 0))
+        key = f'{d.platform}:{d.id}'
+        out[key] = used
+        reg.gauge('perf.hbm_used_bytes', {'device': key}).set(used)
+        with _lock:
+            hist = _hbm_history.get(key)
+            if hist is None or hist.maxlen != streak + 1:
+                hist = collections.deque(maxlen=streak + 1)
+                _hbm_history[key] = hist
+            hist.append(used)
+            growing = (len(hist) == streak + 1 and
+                       all(b > a for a, b in zip(hist, list(hist)[1:])))
+            if growing:
+                hist.clear()
+                hist.append(used)
+        if growing:
+            reg.counter('perf.hbm_leak_suspect', {'device': key}).inc()
+            record_event('perf.hbm_leak_suspect', device=key, bytes=used)
+    return out
+
+
+def report():
+    """Roofline records joined with peaks — the dict behind
+    ``tools/perf_report.py``."""
+    if not cfg.enabled:
+        return None
+    peak_f, peak_bw, source = peaks()
+    reg = _registry()
+    with _lock:
+        rows = [dict(r) for r in _records.values()]
+    for r in rows:
+        h = reg.find('perf.step_ms', {'fn': r['fn']})
+        if h is not None:
+            r['step_ms_p50'] = h.percentile(50)
+        ach = (r['flops'] * 1e3 / r['step_ms_p50']
+               if r.get('step_ms_p50') else None)
+        r['achieved_flops_per_s'] = ach
+        r['frac_of_peak'] = round(ach / peak_f, 4) if ach else None
+    rows.sort(key=lambda r: -r['flops'])
+    return {'device_kind': _device_kind(), 'peak_flops': peak_f,
+            'peak_bw': peak_bw, 'peak_source': source,
+            'ridge': round(peak_f / peak_bw, 4), 'executables': rows}
+
+
+def reset_perf():
+    """Drop stored records + HBM histories (tests, run restarts)."""
+    with _lock:
+        _records.clear()
+        _hbm_history.clear()
+        _mfu_handles.clear()

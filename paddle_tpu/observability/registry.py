@@ -182,6 +182,20 @@ class Histogram:
         with self._lock:
             return percentile(self._samples, q)
 
+    def since(self, count):
+        """Samples observed after lifetime-count ``count`` (capped at the
+        window). Returns ``(current_count, new_samples)`` — the delta-window
+        primitive the SLO watcher evaluates percentiles over, so a breached
+        rule can resolve as soon as fresh traffic is healthy instead of
+        waiting for the full window to cycle."""
+        with self._lock:
+            new = self._count - count
+            if new <= 0:
+                return self._count, []
+            s = list(self._samples)
+            take = min(new, len(s))
+            return self._count, s[len(s) - take:]
+
     @property
     def count(self):
         with self._lock:
@@ -244,6 +258,9 @@ class _NullMetric:
     def percentile(self, q):
         return None
 
+    def since(self, count):
+        return 0, []
+
     def stats(self):
         return {'count': 0, 'sum': 0.0, 'mean': 0.0, 'min': None,
                 'max': None, 'p50': None, 'p90': None, 'p99': None}
@@ -284,6 +301,18 @@ class MetricsRegistry:
 
     def histogram(self, name, labels=None, window=DEFAULT_WINDOW):
         return self._child('histogram', name, labels, window=window)
+
+    def find(self, name, labels=None):
+        """Read-only lookup: the existing child for (name, labels) or
+        ``None`` — never creates a family. The SLO watcher polls through
+        this so a rule over a series that hasn't reported yet does not
+        materialize an empty family in the snapshot."""
+        lk = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam[1].get(lk)
 
     def reset(self):
         with self._lock:
@@ -339,7 +368,8 @@ def _prom_labels(labels):
         return ''
     parts = []
     for k, v in sorted(labels.items()):
-        val = str(v).replace('\\', '\\\\').replace('"', '\\"')
+        val = (str(v).replace('\\', '\\\\').replace('"', '\\"')
+               .replace('\n', '\\n'))
         parts.append(f'{_prom_name(str(k))}="{val}"')
     return '{' + ','.join(parts) + '}'
 
@@ -368,6 +398,12 @@ def histogram(name, labels=None, window=DEFAULT_WINDOW):
     if not cfg.enabled:
         return NULL_METRIC
     return _default.histogram(name, labels, window=window)
+
+
+def find(name, labels=None):
+    if not cfg.enabled:
+        return None
+    return _default.find(name, labels)
 
 
 def snapshot():

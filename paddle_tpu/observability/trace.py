@@ -27,6 +27,7 @@ TRACE_CAP = int(os.environ.get('PADDLE_TPU_OBS_TRACE_CAP', '100000'))
 
 _lock = threading.Lock()
 _events = collections.deque(maxlen=TRACE_CAP)
+_tid_names = {}          # tid -> thread name at record time (for ph:'M')
 _origin_mono = time.perf_counter()
 _origin_wall = time.time()
 
@@ -95,13 +96,15 @@ class Span:
         args = dict(self.attrs) if self.attrs else {}
         if etype is not None:
             args['error'] = f'{etype.__name__}: {evalue}'[:200]
+        tid = threading.get_ident()
         rec = {'name': self.name, 'ph': 'X', 'cat': self.name.split('.')[0],
                'ts': round(self._ts, 3), 'dur': round(end - self._ts, 3),
-               'pid': os.getpid(), 'tid': threading.get_ident()}
+               'pid': os.getpid(), 'tid': tid}
         if args:
             rec['args'] = args
         with _lock:
             _events.append(rec)
+            _tid_names[tid] = threading.current_thread().name
         return False
 
 
@@ -138,13 +141,14 @@ def record_event(name, **attrs):
     circuit transitions."""
     if not cfg.enabled:
         return
+    tid = threading.get_ident()
     rec = {'name': name, 'ph': 'i', 'cat': name.split('.')[0], 's': 't',
-           'ts': round(_now_us(), 3), 'pid': os.getpid(),
-           'tid': threading.get_ident()}
+           'ts': round(_now_us(), 3), 'pid': os.getpid(), 'tid': tid}
     if attrs:
         rec['args'] = attrs
     with _lock:
         _events.append(rec)
+        _tid_names[tid] = threading.current_thread().name
 
 
 def trace_events():
@@ -156,15 +160,23 @@ def trace_events():
 def reset_trace():
     with _lock:
         _events.clear()
+        _tid_names.clear()
 
 
 def dump_trace(path):
     """Write the span ring as Chrome-trace JSON (loads in chrome://tracing
-    and Perfetto). Returns the event count written."""
+    and Perfetto). Returns the event count written. Metadata (``ph:'M'``)
+    events name the process and every thread that recorded a span, so
+    Perfetto lanes read "Thread-dispatch" instead of a bare TID."""
     with _lock:
         events = list(_events)
-    meta = [{'name': 'process_name', 'ph': 'M', 'pid': os.getpid(),
+        tid_names = dict(_tid_names)
+    pid = os.getpid()
+    meta = [{'name': 'process_name', 'ph': 'M', 'pid': pid,
              'args': {'name': 'paddle_tpu'}}]
+    for tid, tname in sorted(tid_names.items()):
+        meta.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
+                     'tid': tid, 'args': {'name': tname}})
     doc = {'traceEvents': meta + events,
            'displayTimeUnit': 'ms',
            'otherData': {'wall_origin': _origin_wall,

@@ -221,14 +221,16 @@ class StepTimer:
 
     @contextlib.contextmanager
     def span(self, phase):
+        """Books elapsed time to ``phase`` ONLY when the body completes.
+        A raising step would otherwise record the partial duration up to
+        the raise — a misleadingly small sample polluting the p50/p99."""
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(phase, time.perf_counter() - t0)
+        yield
+        self.add(phase, time.perf_counter() - t0)
 
     def timed_iter(self, phase, iterable):
-        """Wrap an iterator so the time blocked in next() books to phase."""
+        """Wrap an iterator so the time blocked in next() books to phase.
+        A raising ``next()`` (other than StopIteration) books nothing."""
         it = iter(iterable)
         while True:
             t0 = time.perf_counter()
@@ -236,9 +238,13 @@ class StepTimer:
                 item = next(it)
             except StopIteration:
                 return
-            finally:
-                self.add(phase, time.perf_counter() - t0)
+            self.add(phase, time.perf_counter() - t0)
             yield item
+
+    def abort_step(self):
+        """Discard the partially-accumulated step (the step fn raised):
+        pending phase durations are dropped instead of observed."""
+        self._pending = {p: 0.0 for p in self._pending}
 
     def step_done(self):
         for p, v in self._pending.items():

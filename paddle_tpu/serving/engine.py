@@ -349,10 +349,12 @@ class InferenceEngine:
         padded = [pad_rows(c, bucket) for c in cols]
         t0 = time.perf_counter()
         misses_before = self._cache.misses
+        fn_holder = {}
 
         def device_call():
             fault.inject('serving.dispatch')
             fn = self._cache.get(bucket, sig, self._precision)
+            fn_holder['fn'] = fn
             out = fn(self._params, self._buffers, *padded)
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
             # ONE host readback for the whole batch, then host-side slicing
@@ -369,11 +371,20 @@ class InferenceEngine:
             return
         exec_s = time.perf_counter() - t0
         blbl = {'bucket': str(bucket)}
+        perf_label = f'serving.bucket{bucket}'
         if self._cache.misses > misses_before:
             # first execution at this bucket: includes trace+compile cost
             _obs.histogram('serve.first_exec_ms', blbl).observe(1e3 * exec_s)
         else:
             _obs.histogram('serve.bucket_exec_ms', blbl).observe(1e3 * exec_s)
+            # steady-state wall time only — a compile-inclusive first exec
+            # would poison the live MFU join
+            _obs.perf.note_step(perf_label, exec_s)
+        if _obs.enabled() and _obs.perf.analyzed(perf_label) is None:
+            # cache hit on the executable: publishes perf.flops{fn}/
+            # perf.hbm_bytes{fn,kind}/intensity for this bucket
+            _obs.perf.analyze(perf_label, fn_holder['fn'],
+                              (self._params, self._buffers, *padded))
         _obs.counter('serve.bucket_rows', blbl).inc(rows)
         _obs.counter('serve.bucket_padded_rows', blbl).inc(bucket)
         done_t = self._clock()

@@ -59,6 +59,14 @@ def _scalar_f32():
     return jax.ShapeDtypeStruct((), np.float32)
 
 
+def _perf_analyze(label, compiled):
+    """Publish the prebuilt executable's cost/memory analysis under the SAME
+    label the live path uses, so perf.* series exist before first traffic
+    and a later live ``note_step`` joins them into an MFU."""
+    if _obs.enabled() and _obs.perf.analyzed(label) is None:
+        _obs.perf.analyze_compiled(label, compiled)
+
+
 # ---- per-kind prebuilders --------------------------------------------------
 
 def _prebuild_bucket(engine, entry):
@@ -77,6 +85,7 @@ def _prebuild_bucket(engine, entry):
     buffers = _tree_structs(engine._buffers)
     xs = [_struct((bucket,) + shape, dtype) for shape, dtype in sig]
     compiled = fn.lower(params, buffers, *xs).compile()
+    _perf_analyze(f'serving.bucket{bucket}', compiled)
     return engine._cache.put(bucket, sig, precision, compiled)
 
 
@@ -109,13 +118,17 @@ def _prebuild_train(model, entry):
     key = _key_struct()
     opt_state = _opt_state_structs(model, params)
     if entry['kind'] == 'accum_step':
-        accum_step.lower(params, buffers, params, key, inputs,
-                         labels).compile()
-        apply_accum.lower(params, opt_state, params, _scalar_f32(),
-                          _scalar_f32()).compile()
+        _perf_analyze('hapi.accum_step',
+                      accum_step.lower(params, buffers, params, key, inputs,
+                                       labels).compile())
+        _perf_analyze('hapi.apply_accum',
+                      apply_accum.lower(params, opt_state, params,
+                                        _scalar_f32(),
+                                        _scalar_f32()).compile())
     else:
-        step.lower(params, buffers, opt_state, key, _scalar_f32(),
-                   inputs, labels).compile()
+        _perf_analyze('hapi.train_step',
+                      step.lower(params, buffers, opt_state, key,
+                                 _scalar_f32(), inputs, labels).compile())
     return True
 
 
@@ -132,7 +145,9 @@ def _prebuild_eval(model, entry):
     buffers = _tree_structs(model._buffers_dict())
     inputs = tuple(_struct(s, d) for s, d in in_sig)
     labels = tuple(_struct(s, d) for s, d in lab_sig)
-    step.lower(params, buffers, _key_struct(), inputs, labels).compile()
+    _perf_analyze('hapi.eval_step',
+                  step.lower(params, buffers, _key_struct(), inputs,
+                             labels).compile())
     return True
 
 
@@ -143,7 +158,11 @@ def _prebuild_predictor(predictor, entry):
         return False  # already an AOT executable
     fn = predictor._get_compiled(key)
     structs = [_struct(shape, dtype) for shape, dtype in key]
-    predictor._compiled[key] = fn.lower(*structs).compile()
+    compiled = fn.lower(*structs).compile()
+    predictor._compiled[key] = compiled
+    label = 'predictor.' + ';'.join(
+        'x'.join(map(str, shape)) or 'scalar' for shape, _ in key)
+    _perf_analyze(label, compiled)
     return True
 
 

@@ -159,8 +159,10 @@ def test_span_nesting_and_chrome_trace_schema(tmp_path):
     assert n == 3
     doc = json.loads(path.read_text())
     assert isinstance(doc['traceEvents'], list)
-    assert doc['traceEvents'][0]['ph'] == 'M'      # process_name metadata
-    assert {e['ph'] for e in doc['traceEvents'][1:]} == {'X', 'i'}
+    metas = [e for e in doc['traceEvents'] if e['ph'] == 'M']
+    assert {e['name'] for e in metas} >= {'process_name', 'thread_name'}
+    assert {e['ph'] for e in doc['traceEvents'] if e['ph'] != 'M'} \
+        == {'X', 'i'}
 
 
 def test_span_records_error_and_reraises():

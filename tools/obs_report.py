@@ -9,7 +9,8 @@ present — the top span names by total self time.
 Run:  python tools/obs_report.py <dump_dir | snapshot.json> [--json]
 
 ``--json`` emits the aggregated report as JSON instead of text (for CI
-artifacts). Exits nonzero if the dump cannot be read.
+artifacts). Exits nonzero if the dump cannot be read (2) or contains no
+metrics at all (3) — an empty report in CI is a failure, not a success.
 """
 import argparse
 import collections
@@ -17,7 +18,8 @@ import json
 import os
 import sys
 
-NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data', 'warmup')
+NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data', 'warmup',
+              'perf', 'slo')
 
 
 def _load(path):
@@ -137,6 +139,13 @@ def main(argv=None):
         print(f'obs_report: cannot read dump at {args.path!r}: {e}',
               file=sys.stderr)
         return 2
+    if not any(snap.get(s) for s in ('counters', 'gauges', 'histograms')):
+        # an empty snapshot in CI means the run recorded nothing — fail
+        # loudly instead of printing a blank report that reads as success
+        print(f'obs_report: snapshot at {args.path!r} has no metrics '
+              '(was the run executed with PADDLE_TPU_OBS=0?)',
+              file=sys.stderr)
+        return 3
     report = build_report(snap, trace)
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))

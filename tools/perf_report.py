@@ -1,0 +1,176 @@
+"""One-page roofline report from an observability snapshot.
+
+Joins the ``perf.*`` series published by ``observability.perf`` into a
+per-executable roofline table: static FLOPs and bytes from XLA's
+``cost_analysis()``, arithmetic intensity, the compute-vs-memory-bound
+verdict against the device ridge point, HBM footprint by kind
+(argument/output/temp/code), and — where live step timings joined in —
+measured MFU and achieved-vs-peak FLOPs.
+
+Run:  python tools/perf_report.py <dump_dir | snapshot.json> [--json]
+
+Reads the ``snapshot.json`` written by ``observability.dump(dir)`` /
+``PADDLE_TPU_OBS_DUMP=dir``. Alternatively ``--live`` renders the current
+process registry (useful from a notebook/REPL after a run). Exits nonzero
+when the snapshot cannot be read (2) or holds no ``perf.*`` series (3).
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+_FN_RE = re.compile(r'^perf\.(\w+)\{(.*)\}$')
+_MEM_KINDS = ('argument', 'output', 'temp', 'code')
+
+
+def _labels(inner):
+    out = {}
+    for part in inner.split(','):
+        if '=' in part:
+            k, v = part.split('=', 1)
+            out[k] = v
+    return out
+
+
+def collect(snap):
+    """snapshot dict -> {'peaks': {...}, 'executables': [row, ...]}."""
+    gauges = snap.get('gauges', {})
+    hists = snap.get('histograms', {})
+    rows = {}
+
+    def row(fn):
+        return rows.setdefault(fn, {'fn': fn, 'flops': None, 'bytes': None,
+                                    'intensity': None, 'bound_by': None,
+                                    'mfu': None, 'achieved_flops': None,
+                                    'hbm': {}, 'step_ms_p50': None})
+
+    for key, val in gauges.items():
+        m = _FN_RE.match(key)
+        if not m:
+            continue
+        metric, lbl = m.group(1), _labels(m.group(2))
+        fn = lbl.get('fn')
+        if fn is None:
+            continue
+        r = row(fn)
+        if metric == 'flops':
+            r['flops'] = val
+        elif metric == 'bytes_accessed':
+            r['bytes'] = val
+        elif metric == 'arithmetic_intensity':
+            r['intensity'] = val
+        elif metric == 'compute_bound':
+            r['bound_by'] = 'compute' if val else 'memory'
+        elif metric == 'mfu':
+            r['mfu'] = val
+        elif metric == 'achieved_flops':
+            r['achieved_flops'] = val
+        elif metric == 'hbm_bytes' and 'kind' in lbl:
+            r['hbm'][lbl['kind']] = val
+    for key, st in hists.items():
+        m = _FN_RE.match(key)
+        if m and m.group(1) == 'step_ms':
+            fn = _labels(m.group(2)).get('fn')
+            if fn is not None:
+                row(fn)['step_ms_p50'] = st.get('p50')
+    peaks = {'peak_flops': gauges.get('perf.peak_flops'),
+             'peak_bw': gauges.get('perf.peak_bw'),
+             'ridge': gauges.get('perf.ridge')}
+    execs = sorted(rows.values(), key=lambda r: -(r['flops'] or 0))
+    for r in execs:
+        pf = peaks['peak_flops']
+        r['frac_of_peak'] = (round(r['achieved_flops'] / pf, 8)
+                             if r['achieved_flops'] and pf else None)
+    hbm_dev = {k.split('device=', 1)[1].rstrip('}'): v
+               for k, v in gauges.items()
+               if k.startswith('perf.hbm_used_bytes{')}
+    return {'peaks': peaks, 'executables': execs, 'hbm_used': hbm_dev}
+
+
+def _eng(v, unit=''):
+    if v is None:
+        return '-'
+    for div, suf in ((1e12, 'T'), (1e9, 'G'), (1e6, 'M'), (1e3, 'K')):
+        if abs(v) >= div:
+            return f'{v / div:.2f}{suf}{unit}'
+    return f'{v:.0f}{unit}'
+
+
+def render_text(report):
+    p = report['peaks']
+    lines = ['paddle_tpu roofline report', '=' * 78]
+    lines.append(f'peak: {_eng(p["peak_flops"], "FLOP/s")}  '
+                 f'bw: {_eng(p["peak_bw"], "B/s")}  '
+                 f'ridge: {p["ridge"]} FLOP/B')
+    lines.append('')
+    lines.append(f'{"executable":<26} {"flops":>9} {"bytes":>9} '
+                 f'{"intens":>7} {"bound-by":>8} {"mfu":>7} '
+                 f'{"ach/peak":>8} {"p50 ms":>8}')
+    def _ratio(v):
+        if v is None:
+            return '-'
+        return f'{v:.4f}' if v >= 5e-4 else f'{v:.1e}'
+
+    for r in report['executables']:
+        mfu = _ratio(r['mfu'])
+        frac = _ratio(r['frac_of_peak'])
+        p50 = f'{r["step_ms_p50"]:.2f}' if r['step_ms_p50'] else '-'
+        lines.append(f'{r["fn"]:<26} {_eng(r["flops"]):>9} '
+                     f'{_eng(r["bytes"]):>9} '
+                     f'{r["intensity"] if r["intensity"] is not None else "-":>7} '
+                     f'{r["bound_by"] or "-":>8} {mfu:>7} {frac:>8} {p50:>8}')
+        if r['hbm']:
+            hbm = '  '.join(f'{k}={_eng(r["hbm"].get(k), "B")}'
+                            for k in _MEM_KINDS if k in r['hbm'])
+            lines.append(f'{"":<26} hbm: {hbm}')
+    if report.get('hbm_used'):
+        lines.append('')
+        lines.append('[hbm in use]')
+        for dev, v in sorted(report['hbm_used'].items()):
+            lines.append(f'  {dev:<24} {_eng(v, "B")}')
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('path', nargs='?',
+                    help='dump directory or snapshot.json')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the aggregated report as JSON')
+    ap.add_argument('--live', action='store_true',
+                    help='render the current process registry instead of '
+                         'a file (for REPL/notebook use)')
+    args = ap.parse_args(argv)
+    if args.live:
+        from paddle_tpu import observability as obs
+        snap = obs.snapshot()
+    else:
+        if not args.path:
+            print('perf_report: a dump path is required (or --live)',
+                  file=sys.stderr)
+            return 2
+        snap_path = (os.path.join(args.path, 'snapshot.json')
+                     if os.path.isdir(args.path) else args.path)
+        try:
+            with open(snap_path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f'perf_report: cannot read snapshot at {snap_path!r}: {e}',
+                  file=sys.stderr)
+            return 2
+    report = collect(snap)
+    if not report['executables']:
+        print('perf_report: no perf.* series in snapshot — did the run '
+              'execute any instrumented step with PADDLE_TPU_OBS enabled?',
+              file=sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
