@@ -431,6 +431,19 @@ def _child_warmup():
     print(json.dumps(warmup_check.run_check()))
 
 
+def _child_decode_cb():
+    """Continuous-batching decode row: aggregate tok/s and TTFT of the
+    GenerationEngine (iteration-level batching over the paged KV cache) vs
+    request-at-a-time batch-1 decode on the same ragged Poisson request
+    stream (the tools/decode_bench.py measurement)."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import decode_bench
+    print(json.dumps(decode_bench.run_bench(requests=8)))
+
+
 def _child_obs_overhead():
     """Observability overhead probe: steps/s of a small hapi fit loop, run
     by the parent twice (PADDLE_TPU_OBS=0 and =1) so the <5% budget of the
@@ -838,6 +851,18 @@ def main(fast=False):
         else:
             print(f'warmup check failed: {wnote}', file=sys.stderr)
 
+        cb, cbnote = _run_child(['--child-decode-cb'], PREDICTOR_TIMEOUT_S)
+        if cb is not None:
+            out['decode_cb_tokens_per_sec'] = cb['decode_cb_tokens_per_sec']
+            out['decode_rr_tokens_per_sec'] = cb['decode_rr_tokens_per_sec']
+            out['decode_cb_speedup'] = cb['cb_speedup']
+            out['ttft_p99_ms'] = cb['ttft_p99_ms']
+            out['decode_cb_compiles_ok'] = cb['compiles_ok']
+            out['decode_cb_tokens_match'] = cb['tokens_match']
+        else:
+            print(f'continuous-batching decode bench failed: {cbnote}',
+                  file=sys.stderr)
+
         eager, enote = _run_child(['--child-eager'], 180)
         if eager is not None:
             out['eager_ops_per_sec'] = round(eager['eager_ops_per_sec'], 1)
@@ -934,6 +959,8 @@ if __name__ == '__main__':
         _child_decode()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-serving':
         _child_serving()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-decode-cb':
+        _child_decode_cb()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-warmup':
         _child_warmup()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':

@@ -40,6 +40,10 @@ def main():
                    help='weight-only int8 decode (halved weight HBM bytes)')
     p.add_argument('--int8-kv', action='store_true',
                    help='int8 KV cache (per-row scales; int8 decode kernel)')
+    p.add_argument('--stream', action='store_true',
+                   help='serve through the continuous-batching '
+                        'GenerationEngine and print tokens as each decode '
+                        'iteration emits them')
     args = p.parse_args()
     apply_platform(args)
     if args.hidden < 64 or args.hidden % 64:
@@ -59,6 +63,34 @@ def main():
     prompt = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size,
                           (args.batch, 16)).astype('int32'))
+    if args.stream:
+        # continuous batching: every prompt is its own request; the engine
+        # interleaves them at the decode-iteration level and each future's
+        # stream() yields tokens the moment their iteration completes
+        from paddle_tpu.serving import GenerationEngine
+        engine = GenerationEngine(
+            model, num_slots=max(args.batch, 2),
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p)
+        engine.warmup()            # both executables built before traffic
+        rows = np.asarray(prompt.numpy(), dtype=np.int32)
+        t0 = time.perf_counter()
+        futs = [engine.submit(rows[b], max_new_tokens=args.tokens, seed=b)
+                for b in range(args.batch)]
+        n_out = 0
+        for b, fut in enumerate(futs):
+            sys.stdout.write(f'seq {b}: ')
+            for tok in fut.stream(timeout=600):
+                sys.stdout.write(f'{tok} ')
+                sys.stdout.flush()
+                n_out += 1
+            sys.stdout.write('\n')
+        dt = time.perf_counter() - t0
+        engine.shutdown()
+        print(f'streamed {n_out} tokens in {dt:.2f}s '
+              f'({n_out / dt:,.1f} tok/s); stats: '
+              f'{ {k: engine.stats()[k] for k in ("steps", "evictions", "traces")} }')
+        return
     # warm the prefill+step compiles
     model.generate(prompt, max_new_tokens=2, temperature=0)
     t0 = time.perf_counter()

@@ -1,0 +1,76 @@
+"""Bounded LRU memoization for decode-path compiled-fn factories.
+
+gpt/moe_gpt memoize their jitted decode fns and on-device generate loops
+keyed on (config, sampling knobs). The original module-level dicts grew
+without bound — every distinct config/temperature/top_k combination pinned
+its compiled executables (and their HBM constants) forever, a real leak
+for long-lived serving processes that cycle model configs. Every such
+cache now goes through ``DecodeFnCache``: an LRU bounded at ``maxsize``
+entries whose evictions simply drop the reference (XLA frees the
+executable with it), plus a weak global registry so tests can wipe every
+decode cache in one call (``clear_decode_caches``)."""
+import os
+import threading
+import weakref
+from collections import OrderedDict
+
+_REGISTRY = weakref.WeakSet()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _default_maxsize():
+    try:
+        v = int(os.environ.get('PADDLE_TPU_DECODE_CACHE_SIZE', 8))
+    except ValueError:
+        return 8
+    return v if v > 0 else 8
+
+
+class DecodeFnCache:
+    """Thread-safe bounded LRU: ``get(key, builder)`` returns the cached
+    value, building (and possibly evicting the least-recently-used entry)
+    on miss. Instances register themselves weakly for
+    ``clear_decode_caches``; per-model instances are collected normally."""
+
+    def __init__(self, maxsize=None, name=None):
+        self.maxsize = int(maxsize) if maxsize else _default_maxsize()
+        if self.maxsize < 1:
+            raise ValueError('maxsize must be >= 1')
+        self.name = name or 'decode_cache'
+        self._data = OrderedDict()
+        self._lock = threading.RLock()
+        with _REGISTRY_LOCK:
+            _REGISTRY.add(self)
+
+    def get(self, key, builder):
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                return self._data[key]
+            value = builder()
+            self._data[key] = value
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
+            return value
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._data)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._data
+
+
+def clear_decode_caches():
+    """Drop every live decode-fn/generate-loop cache (module-level and
+    per-model instances). Tests use this to force retraces; serving code
+    can use it to release executables after a config rollover."""
+    with _REGISTRY_LOCK:
+        caches = list(_REGISTRY)
+    for c in caches:
+        c.clear()

@@ -411,16 +411,63 @@ def init_kv_cache(config: GPTConfig, batch):
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
-def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
+def is_paged(cache):
+    """True when ``cache`` is a paged decode cache: ``{'k','v'}`` page
+    pools (ops/paged_kv) plus a ``'page_table'`` [B, P_max] i32 and an
+    optional ``'valid'`` [B] i32 (prefill: per-slot real prompt lengths,
+    padding rows past it route to the trash page)."""
+    return isinstance(cache, dict) and 'page_table' in cache
+
+
+def init_paged_kv_cache(config, num_pages, page_size):
+    """Shared page pool for the continuous-batching decode path:
+    ``{'k','v': [L, num_pages, page_size, H_kv, Dh]}`` (int8 banks with
+    ``config.kv_cache_int8``). Pair with a per-slot page table + ``pos``
+    vector to form the paged cache ``forward_with_cache`` accepts; the
+    dense ``init_kv_cache`` remains the default for ``generate()``."""
+    from ..ops.paged_kv import init_paged_pool
+    return init_paged_pool(config.num_layers, num_pages, page_size,
+                           config.kv_heads, config.head_dim,
+                           jnp.dtype(config.dtype),
+                           int8=config.kv_cache_int8)
+
+
+def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt,
+                     page_table=None, valid=None):
     """Shared KV-cache attention core (used by gpt AND moe_gpt decode):
     writes rows [pos, pos+T) into the caches, attends each q row to cache
     positions <= its absolute index, applies the output projection +
     residual. Returns (x_new, k_cache, v_cache). Caches may be raw
     ``[B, S_max, H_kv, D]`` arrays or int8 banks (init_kv_cache with
     ``kv_cache_int8``): fresh rows quantize on write and attention runs
-    the int8 flash decode kernel (or a dequantizing fallback)."""
+    the int8 flash decode kernel (or a dequantizing fallback).
+
+    Paged mode (``page_table`` not None): the caches are single-layer page
+    pools ``[N, page_size, H_kv, D]`` (or int8 banks), ``pos`` is a [B]
+    i32 vector (slots decode at different depths), and multi-token calls
+    are prefills starting at position 0 per slot. Rows past ``valid[b]``
+    are prompt padding and land in the trash page (ops/paged_kv)."""
     from ..ops.weight_only import dequantize_kv, is_weight_only, quantize_kv
     B, T, h = x.shape
+    if page_table is not None:
+        from ..ops.paged_attention import paged_attention
+        from ..ops.paged_kv import paged_write
+        k_cache = paged_write(k_cache, k, page_table, pos, valid)
+        v_cache = paged_write(v_cache, v, page_table, pos, valid)
+        from ..ops.flash_attention import (flash_attention,
+                                           flash_attention_available)
+        if T > 1 and flash_attention_available(q, k, v, None):
+            # multi-token paged calls are engine prefills from position 0:
+            # attention over the paged cache equals causal self-attention
+            # over the fresh rows (padding rows only feed padding rows,
+            # which the engine discards) — run the main flash kernel
+            # instead of gathering the virtual cache
+            a = flash_attention(q, k, v, causal=True).reshape(B, T, h)
+        else:
+            a = paged_attention(q, k_cache, v_cache, page_table, pos,
+                                cdt).reshape(B, T, h)
+        return (x + wo_matmul(a, proj_w, cdt) + proj_b.astype(cdt),
+                k_cache, v_cache)
     int8_cache = is_weight_only(k_cache)
     if int8_cache:
         def write(bank, rows):
@@ -469,17 +516,60 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt):
             k_cache, v_cache)
 
 
-def _cached_block(bp, x, k_cache, v_cache, pos, config):
+def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
+                  valid=None):
     """One block over a [B, T, H] slice starting at ``pos``."""
     cdt = jnp.dtype(config.dtype)
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     q, k, v = _block_qkv(bp, y, config.num_heads, config.head_dim, cdt,
                          config.kv_heads)
     x, k_cache, v_cache = cached_attention(
-        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
+        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt,
+        page_table=page_table, valid=valid)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     x = x + _block_mlp(bp, y, cdt) + bp['out_b'].astype(cdt)
     return x, k_cache, v_cache
+
+
+def paged_forward_with_cache(params, tokens, cache, pos, config,
+                             last_only=False, block=_cached_block):
+    """Paged-cache twin of ``forward_with_cache``: ``cache`` carries the
+    page pools + ``page_table`` (+ optional ``valid``), ``pos`` is a [B]
+    i32 vector. ``block`` lets moe_gpt reuse this driver with its own
+    block body. Returns (logits, cache) with the table/valid passed
+    through so the caller's cache pytree keeps one structure."""
+    cdt = jnp.dtype(config.dtype)
+    B, T = tokens.shape
+    pos_v = jnp.asarray(pos, jnp.int32).reshape(-1)
+    page_table = cache['page_table']
+    valid = cache.get('valid')
+    ppos = jnp.clip(pos_v[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
+                    0, config.max_seq_len - 1)            # [B, T]
+    x = (wo_take(params['wte'], tokens)
+         + jnp.take(params['wpe'], ppos, axis=0)).astype(cdt)
+
+    def scan_body(carry, inp):
+        xx = carry
+        bp, kc, vc = inp
+        xx, kc, vc = block(bp, xx, kc, vc, pos_v, config,
+                           page_table=page_table, valid=valid)
+        return xx, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_body, x, (params['blocks'], cache['k'], cache['v']))
+    if last_only:
+        if valid is not None:
+            # per-slot prompt lengths: pick each slot's last REAL row
+            idx = jnp.clip(valid.astype(jnp.int32) - 1, 0, T - 1)
+            x = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+        else:
+            x = x[:, -1:]
+    x = _layer_norm(x, params['lnf_g'], params['lnf_b']).astype(cdt)
+    logits = wo_lm_head(x, params['wte'], cdt)
+    out = {'k': k_new, 'v': v_new, 'page_table': page_table}
+    if valid is not None:
+        out['valid'] = valid
+    return logits, out
 
 
 def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
@@ -490,7 +580,14 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
     head matmul for all but the final position: at B=8, T0=1000, V=50304
     that matmul and its ~1.6 GB logits tensor are pure waste).
     T is the static block width: the prompt length at prefill, 1 per decode
-    step — each width compiles exactly once."""
+    step — each width compiles exactly once.
+
+    A paged cache (``is_paged``: page pools + ``page_table``) routes to
+    ``paged_forward_with_cache`` with ``pos`` as a per-slot [B] vector;
+    the dense contiguous cache stays the default."""
+    if is_paged(cache):
+        return paged_forward_with_cache(params, tokens, cache, pos, config,
+                                        last_only=last_only)
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)
@@ -950,11 +1047,10 @@ class GPTForCausalLM(Layer):
         key = (temperature, top_k, top_p)
         cache = getattr(self, '_gen_loops', None)
         if cache is None:
-            cache = self._gen_loops = {}
-        if key not in cache:
-            cache[key] = make_generate_loop(self.config, temperature,
-                                            top_k, top_p)
-        return cache[key]
+            from .decode_cache import DecodeFnCache
+            cache = self._gen_loops = DecodeFnCache(name='gpt.gen_loops')
+        return cache.get(key, lambda: make_generate_loop(
+            self.config, temperature, top_k, top_p))
 
     def enable_int8_decode(self, enable=True):
         """Serve ``generate`` from weight-only int8 matrices (halved HBM

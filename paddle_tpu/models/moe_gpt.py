@@ -216,14 +216,16 @@ def init_kv_cache(config: 'MoEConfig', batch):
     return {'k': jnp.zeros(shape, cdt), 'v': jnp.zeros(shape, cdt)}
 
 
-def _cached_block(bp, x, k_cache, v_cache, pos, config):
+def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
+                  valid=None):
     cdt = jnp.dtype(config.dtype)
     B, T, h = x.shape
     nh, hd = config.num_heads, config.head_dim
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
     x, k_cache, v_cache = cached_attention(
-        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt)
+        x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt,
+        page_table=page_table, valid=valid)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, _ = moe_ffn(y, bp['gate_w'].astype(cdt), _c(bp['w_in'], cdt),
                     _c(bp['w_out'], cdt),
@@ -233,7 +235,17 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config):
 
 def forward_with_cache(params, tokens, cache, pos, config, last_only=False):
     """[B, T] tokens at absolute positions starting at ``pos`` (traced
-    scalar) -> (logits, cache). See gpt.forward_with_cache."""
+    scalar) -> (logits, cache). See gpt.forward_with_cache. A paged cache
+    (gpt.is_paged) routes through gpt.paged_forward_with_cache with THIS
+    module's block body (MoE FFN per token; note the capacity caveat in
+    the section comment above — decode slots in one batch compete for
+    expert capacity, so exact dense parity needs generous
+    capacity_factor)."""
+    from .gpt import is_paged, paged_forward_with_cache
+    if is_paged(cache):
+        return paged_forward_with_cache(params, tokens, cache, pos, config,
+                                        last_only=last_only,
+                                        block=_cached_block)
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)
@@ -272,16 +284,18 @@ def make_decode_fns(config):
     return prefill, step
 
 
-_decode_fns_cache = {}
+from .decode_cache import DecodeFnCache
+
+_decode_fns_cache = DecodeFnCache(name='moe_gpt.decode_fns')
 
 
 def _decode_fns_for(config):
-    """Memoize per config: repeated generate() calls must not rebuild the
-    jit closures (and so recompile prefill/step) every time."""
+    """Memoize per config (bounded LRU — see models/decode_cache.py):
+    repeated generate() calls must not rebuild the jit closures (and so
+    recompile prefill/step) every time, and abandoned configs must not pin
+    their executables forever."""
     cfg_key = tuple(sorted(dataclasses.asdict(config).items()))
-    if cfg_key not in _decode_fns_cache:
-        _decode_fns_cache[cfg_key] = make_decode_fns(config)
-    return _decode_fns_cache[cfg_key]
+    return _decode_fns_cache.get(cfg_key, lambda: make_decode_fns(config))
 
 
 def generate(params, config, prompt, max_new_tokens, temperature=0.0,
@@ -330,22 +344,19 @@ def generate(params, config, prompt, max_new_tokens, temperature=0.0,
     return jnp.concatenate(pieces, axis=1)
 
 
-_GEN_LOOPS = {}
+_GEN_LOOPS = DecodeFnCache(name='moe_gpt.gen_loops')
 
 
 def _generate_loop_for(config, temperature, top_k, top_p):
     """Memoized on-device decode loop — gpt.make_generate_loop with THIS
     module's cached forward (one loop implementation for both models; a
     fresh jit wrapper per generate() call would recompile the scanned
-    program every time — review r5g)."""
+    program every time — review r5g). Bounded LRU: see decode_cache.py."""
     import dataclasses
     from .gpt import make_generate_loop
     cache_key = (dataclasses.astuple(config), temperature, top_k, top_p)
-    if cache_key not in _GEN_LOOPS:
-        _GEN_LOOPS[cache_key] = make_generate_loop(
-            config, temperature, top_k, top_p,
-            forward_fn=forward_with_cache)
-    return _GEN_LOOPS[cache_key]
+    return _GEN_LOOPS.get(cache_key, lambda: make_generate_loop(
+        config, temperature, top_k, top_p, forward_fn=forward_with_cache))
 
 
 def make_train_step(config, optimizer, mesh=None):

@@ -1,0 +1,168 @@
+"""Paged KV cache: fixed-size pages in a shared pool + per-slot page tables.
+
+The dense decode cache (`models/gpt.init_kv_cache`) reserves a contiguous
+``[L, B, S_max, H_kv, Dh]`` strip per request — at S_max=2048 a slot holds
+its worst-case footprint for its whole lifetime even when the sequence is
+30 tokens long. The paged layout (vLLM / "Ragged Paged Attention",
+PAPERS.md arxiv 2604.15464) breaks the cache into fixed-size pages in one
+shared pool:
+
+    pool      [L, N_pages, page_size, H_kv, Dh]   (k and v each)
+    table     [slots, P_max] int32                (page ids per slot)
+
+so a sequence only pins ``ceil(len/page_size)`` pages and the continuous-
+batching engine (serving/generation.py) packs many ragged sequences into
+one fixed-slot decode batch. Page ids are HOST-side state handed to the
+compiled step as a traced int32 table — page churn never recompiles.
+
+Conventions shared by every consumer:
+
+ - **Page 0 is the trash page.** The allocator never hands it out. Writes
+   that must go nowhere (prompt padding rows past a sequence's valid
+   length, decode rows of inactive slots) are routed to page 0, and
+   unassigned page-table entries stay 0 — a gather through a fresh table
+   reads zeros, and the attention mask discards those positions anyway.
+ - Pages are layer-major so ``lax.scan`` over the layer stack slices the
+   leading dim exactly like the dense cache.
+ - int8-KV pools reuse the ``{'int8', 'scale'}`` bank layout of
+   ops/weight_only (per-row scales), so the +32% int8 decode win composes.
+"""
+import threading
+
+import jax.numpy as jnp
+
+from .weight_only import init_kv_bank, is_weight_only, quantize_kv
+
+TRASH_PAGE = 0   # reserved; see module docstring
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to hold ``n_tokens`` rows."""
+    return max(0, -(-int(n_tokens) // int(page_size)))
+
+
+def init_paged_pool(num_layers, num_pages, page_size, kv_heads, head_dim,
+                    dtype, int8=False):
+    """Allocate the shared page pool: ``{'k': pages, 'v': pages}`` with
+    pages ``[L, N, page_size, H_kv, Dh]`` (int8: weight_only banks of the
+    same shape). ``num_pages`` INCLUDES the reserved trash page 0."""
+    if num_pages < 2:
+        raise ValueError('num_pages must be >= 2 (page 0 is reserved)')
+    shape = (num_layers, num_pages, page_size, kv_heads, head_dim)
+    if int8:
+        return {'k': init_kv_bank(shape), 'v': init_kv_bank(shape)}
+    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+
+
+class PageAllocator:
+    """Host-side free-list over pages ``1..num_pages-1`` (page 0 reserved).
+
+    All-or-nothing ``alloc(n)``: a request either gets all n pages or None,
+    so a half-admitted sequence never strands pages. Thread-safe (the
+    engine's scheduler thread and stats readers may race)."""
+
+    def __init__(self, num_pages):
+        if num_pages < 2:
+            raise ValueError('num_pages must be >= 2 (page 0 is reserved)')
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages - 1, 0, -1))  # pop() -> low ids
+        self._lock = threading.Lock()
+
+    @property
+    def free_pages(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self):
+        return (self.num_pages - 1) - self.free_pages
+
+    def alloc(self, n):
+        """-> list of n page ids, or None if the pool can't cover them."""
+        n = int(n)
+        if n < 0:
+            raise ValueError('alloc(n) needs n >= 0')
+        with self._lock:
+            if n > len(self._free):
+                return None
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages):
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if not 0 < p < self.num_pages:
+                    raise ValueError(f'free() of invalid page id {p}')
+                if p in self._free:
+                    raise ValueError(f'double free of page {p}')
+                self._free.append(p)
+
+
+def flat_write_indices(page_table, pos, n_rows, page_size, valid=None):
+    """[B, n_rows] int32 indices into a ``[N*page_size, ...]`` flattened
+    pool for the rows a (prefill or decode) step writes.
+
+    ``page_table``: [B, P_max] i32; ``pos``: [B] i32 (absolute position of
+    each sequence's first new row); ``valid``: [B] i32 or None — rows with
+    j >= valid[b] are padding and route to the trash page (index j inside
+    page 0, which real pages can never alias since they start at
+    ``page_size``)."""
+    ps = int(page_size)
+    p_max = int(page_table.shape[1])
+    j = jnp.arange(n_rows, dtype=jnp.int32)[None, :]          # [1, T]
+    abs_pos = pos.astype(jnp.int32)[:, None] + j              # [B, T]
+    logical = jnp.clip(abs_pos // ps, 0, p_max - 1)
+    page = jnp.take_along_axis(page_table, logical, axis=1)   # [B, T]
+    flat = page * ps + abs_pos % ps
+    if valid is not None:
+        ok = j < valid.astype(jnp.int32)[:, None]
+        # trash rows: distinct offsets inside page 0 (j % ps) — collisions
+        # between sequences are fine, the rows are garbage by definition
+        flat = jnp.where(ok, flat, j % ps)
+    return flat
+
+
+def paged_write(pages, rows, page_table, pos, valid=None):
+    """Scatter new KV rows into the (single-layer) page pool.
+
+    ``pages``: [N, page_size, H, D] (or an int8 bank of that shape);
+    ``rows``: [B, T, H, D] fresh k or v rows for absolute positions
+    ``pos[b] + j``; ``page_table``: [B, P_max]; ``valid``: [B] or None
+    (rows past it go to the trash page). Returns the updated pool.
+
+    int8 banks quantize the incoming rows with the same per-row scheme as
+    the dense int8 cache (ops/weight_only.quantize_kv), so paged int8
+    decode matches dense int8 decode row-for-row."""
+    b, t = rows.shape[:2]
+    if is_weight_only(pages):
+        n, ps, h, d = pages['int8'].shape
+        idx = flat_write_indices(page_table, pos, t, ps, valid).reshape(-1)
+        q, scale = quantize_kv(rows)
+        int8 = pages['int8'].reshape(n * ps, h, d)
+        int8 = int8.at[idx].set(q.reshape(b * t, h, d))
+        sc = pages['scale'].reshape(n * ps, h)
+        sc = sc.at[idx].set(scale.reshape(b * t, h))
+        return {'int8': int8.reshape(n, ps, h, d),
+                'scale': sc.reshape(n, ps, h)}
+    n, ps, h, d = pages.shape
+    idx = flat_write_indices(page_table, pos, t, ps, valid).reshape(-1)
+    flat = pages.reshape(n * ps, h, d)
+    flat = flat.at[idx].set(rows.reshape(b * t, h, d).astype(pages.dtype))
+    return flat.reshape(n, ps, h, d)
+
+
+def gather_virtual(pages, page_table):
+    """Reconstruct each slot's virtual dense cache from its pages:
+    ``[N, page_size, H, D]`` + ``[B, P_max]`` -> ``[B, P_max*page_size,
+    H, D]``. int8 banks gather both planes. This is the pure-jnp fallback
+    the paged-attention path (and CPU tier-1 tests) build on: the result
+    is value-identical to the dense cache regardless of physical page
+    placement, which is what makes paged-vs-dense greedy bit-parity a
+    testable property."""
+    if is_weight_only(pages):
+        return {'int8': gather_virtual(pages['int8'], page_table),
+                'scale': gather_virtual(pages['scale'], page_table)}
+    g = jnp.take(pages, page_table, axis=0)       # [B, P_max, ps, ...]
+    b, p_max, ps = g.shape[:3]
+    return g.reshape((b, p_max * ps) + g.shape[3:])

@@ -25,9 +25,11 @@ from .errors import (DeadlineExceededError, EngineClosedError,  # noqa: F401
                      QueueFullError)
 from .metrics import ServingStats  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .generation import GenerationEngine, GenerationFuture  # noqa: F401
 
 __all__ = [
     'InferenceEngine', 'ServingStats', 'BucketCompileCache',
+    'GenerationEngine', 'GenerationFuture',
     'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',
     'QueueFullError', 'DeadlineExceededError', 'EngineClosedError',
 ]

@@ -1,0 +1,766 @@
+"""GenerationEngine: continuous (iteration-level) batching for LLM decode.
+
+``InferenceEngine`` batches whole requests; autoregressive generation
+can't wait for a batch — requests arrive ragged, produce different
+numbers of tokens, and a fixed-batch ``generate()`` call head-of-line
+blocks every sequence on the longest one. This engine schedules at the
+*iteration* level (the Orca discipline, PAPERS.md arxiv 2309.06180 /
+2604.15464): a fixed number of decode **slots** runs ONE compiled decode
+step per iteration, and the host scheduler admits new sequences into free
+slots and retires finished ones *between* steps. Two executables serve
+the whole workload:
+
+ - ``prefill``: batch-1, prompts padded to a fixed ``prefill_width`` —
+   one program for every prompt length (pad rows are routed to the paged
+   pool's trash page and the last REAL row's logits sample token 0);
+ - ``step``: all ``num_slots`` rows advance one token — inactive slots
+   decode garbage into the trash page and their sample is discarded.
+
+KV state lives in a paged pool (``ops/paged_kv.py``): fixed-size pages
+in one shared buffer, a per-slot page table, and a host-side free-list
+allocator, so slot occupancy — not worst-case sequence length — bounds
+HBM. Pages are allocated lazily at each page boundary; on exhaustion the
+most-recently-admitted active slot — possibly the requester itself — is
+evicted (pages freed, request requeued at the queue FRONT), so the oldest
+sequence always advances and no pair of growing sequences can livelock
+each other. Sampling keys are derived per slot as
+``fold_in(PRNGKey(seed), position)``, so a restarted sequence
+regenerates byte-identical tokens and its future never re-emits ones
+already streamed.
+
+Robustness / telemetry reuse the serving stack: bounded admission queue
+(``QueueFullError``), per-request deadlines (``DeadlineExceededError``),
+a ``fault.CircuitBreaker`` + ``gen.step`` chaos point around device
+calls, ``gen.*`` metrics in the observability registry, and warmup
+manifest capture (``gen_prefill`` / ``gen_decode`` entries) so a new
+process prebuilds both executables before traffic.
+
+Env knobs: ``PADDLE_TPU_GEN_SLOTS`` (default 8),
+``PADDLE_TPU_GEN_PAGE_SIZE`` (default 128, clamped to max_seq_len).
+"""
+import itertools
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fault
+from .. import observability as _obs
+from ..models import gpt as _gpt
+from ..ops import paged_kv as _pkv
+from .errors import DeadlineExceededError, EngineClosedError, QueueFullError
+
+ENV_SLOTS = 'PADDLE_TPU_GEN_SLOTS'
+ENV_PAGE_SIZE = 'PADDLE_TPU_GEN_PAGE_SIZE'
+
+_HIST_WINDOW = 4096
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class GenerationFuture:
+    """Handle for one submitted sequence. ``result()`` blocks for the full
+    token list; ``stream()`` yields tokens as decode iterations emit them.
+    Eviction/readmission never re-yields: regenerated tokens are only
+    appended past what the future already holds."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._tokens = []
+        self._done = False
+        self._exc = None
+
+    # ---- engine-internal ------------------------------------------------
+    def _count(self):
+        with self._cv:
+            return len(self._tokens)
+
+    def _append(self, tok):
+        with self._cv:
+            if self._done:
+                return
+            self._tokens.append(int(tok))
+            self._cv.notify_all()
+
+    def _finish(self, exc=None):
+        with self._cv:
+            if self._done:
+                return False
+            self._done = True
+            self._exc = exc
+            self._cv.notify_all()
+            return True
+
+    # ---- caller API -----------------------------------------------------
+    def done(self):
+        with self._cv:
+            return self._done
+
+    def exception(self, timeout=None):
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._done, timeout):
+                raise TimeoutError('generation still running')
+            return self._exc
+
+    def result(self, timeout=None):
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        with self._cv:
+            return list(self._tokens)
+
+    def stream(self, timeout=None):
+        """Generator of tokens in emission order; returns at EOS/limit,
+        raises the failure exception if the sequence failed."""
+        i = 0
+        while True:
+            with self._cv:
+                if not self._cv.wait_for(
+                        lambda: self._done or i < len(self._tokens), timeout):
+                    raise TimeoutError('generation stalled')
+                if i < len(self._tokens):
+                    tok = self._tokens[i]
+                    i += 1
+                elif self._exc is not None:
+                    raise self._exc
+                else:
+                    return
+            yield tok
+
+
+class _Request:
+    __slots__ = ('prompt', 'eff_max_new', 'seed', 'future', 'enqueue_t',
+                 'deadline_t', 'evictions', 'ttft_noted')
+
+    def __init__(self, prompt, eff_max_new, seed, future, enqueue_t,
+                 deadline_t):
+        self.prompt = prompt
+        self.eff_max_new = eff_max_new
+        self.seed = seed
+        self.future = future
+        self.enqueue_t = enqueue_t
+        self.deadline_t = deadline_t
+        self.evictions = 0
+        self.ttft_noted = False
+
+
+class _Slot:
+    __slots__ = ('req', 'pos', 'last_tok', 'produced', 'table', 'admit_seq')
+
+    def __init__(self, req, table, admit_seq):
+        self.req = req
+        self.pos = len(req.prompt)      # next KV write position
+        self.last_tok = 0
+        self.produced = 0
+        self.table = table              # np [p_max] i32, 0 = unallocated
+        self.admit_seq = admit_seq
+
+
+def _resolve_generation_model(net, config, forward_fn):
+    """Accept a GPTForCausalLM-style Layer (has .config + _params) or a
+    (params, config) functional pair; infer the forward fn from the config
+    family when not given."""
+    if config is None:
+        cfg = getattr(net, 'config', None)
+        if cfg is None:
+            raise TypeError(
+                'GenerationEngine needs a model with a .config or an '
+                'explicit (params, config) pair')
+        if hasattr(net, '_decode_params'):
+            params = net._decode_params()
+        else:
+            params = net._params()
+    else:
+        params, cfg = net, config
+    if forward_fn is None:
+        if 'moe' in type(cfg).__name__.lower():
+            from ..models import moe_gpt
+            forward_fn = moe_gpt.forward_with_cache
+        else:
+            forward_fn = _gpt.forward_with_cache
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return params, cfg, forward_fn
+
+
+class GenerationEngine:
+    """Continuous-batching generation over one causal-LM model.
+
+    ``submit(prompt)`` returns a ``GenerationFuture`` immediately; the
+    scheduler thread prefills it into a free slot and advances it one
+    token per decode iteration alongside every other active sequence.
+    Sampling knobs (temperature/top_k/top_p, greedy by default) are
+    engine-wide — one executable — while the RNG seed is per-request.
+    """
+
+    _seq = itertools.count()
+
+    def __init__(self, net, config=None, *, num_slots=None, page_size=None,
+                 num_pages=None, prefill_width=None, temperature=0.0,
+                 top_k=None, top_p=None, eos_id=None, queue_capacity=64,
+                 default_deadline_ms=None, breaker=None, autostart=True,
+                 forward_fn=None, clock=None):
+        if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
+            from .. import warmup as _warmup_mod
+            _warmup_mod.ensure_persistent_cache()
+        params, cfg, fwd = _resolve_generation_model(net, config, forward_fn)
+        self._params = params
+        self.config = cfg
+        self._forward_fn = fwd
+
+        s_max = int(cfg.max_seq_len)
+        self.max_seq_len = s_max
+        self.num_slots = int(num_slots if num_slots is not None
+                             else _env_int(ENV_SLOTS, 8))
+        ps = int(page_size if page_size is not None
+                 else min(_env_int(ENV_PAGE_SIZE, 128), s_max))
+        if ps < 1:
+            raise ValueError(f'page_size must be >= 1, got {ps}')
+        self.page_size = ps
+        self.p_max = _pkv.pages_for(s_max, ps)
+        self.prefill_width = int(prefill_width if prefill_width is not None
+                                 else s_max)
+        if not 1 <= self.prefill_width <= s_max:
+            raise ValueError(
+                f'prefill_width {self.prefill_width} outside '
+                f'[1, {s_max}]')
+        # +1: page 0 is the reserved trash page
+        self.num_pages = int(num_pages if num_pages is not None
+                             else self.num_slots * self.p_max + 1)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self._breaker = breaker if breaker is not None else \
+            fault.CircuitBreaker(failure_threshold=5, recovery_timeout=5.0)
+        self._clock = clock or time.monotonic
+        self._autostart = autostart
+
+        self._pool = _gpt.init_paged_kv_cache(cfg, self.num_pages, ps)
+        self._alloc = _pkv.PageAllocator(self.num_pages)
+        self._slots = [None] * self.num_slots
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._thread = None
+        self._closed = False
+        self._draining = False
+        self._admit_seq = 0
+        self._trace_count = 0
+        self._fns = None
+        # kind -> AOT Compiled executable, seeded by warmup/prebuild; the
+        # live path prefers these (a jit callable's first real call would
+        # still pay the executable build even when the trace is cached)
+        self._aot = {}
+        self._start_t = self._clock()
+        self._n = {k: 0 for k in ('submitted', 'completed', 'rejected',
+                                  'expired', 'failed', 'evictions',
+                                  'tokens', 'prefills', 'steps')}
+        self._make_metrics()
+
+    # ---- telemetry -------------------------------------------------------
+    def _make_metrics(self):
+        labels = {'engine': f'g{next(GenerationEngine._seq)}'}
+        self.labels = labels
+        if _obs.enabled():
+            reg = _obs.registry()
+            mk_c = lambda n: reg.counter(n, labels)             # noqa: E731
+            mk_h = lambda n: reg.histogram(n, labels,           # noqa: E731
+                                           window=_HIST_WINDOW)
+            mk_g = lambda n: reg.gauge(n, labels)               # noqa: E731
+        else:
+            mk_c = lambda n: _obs.Counter(n, labels)            # noqa: E731
+            mk_h = lambda n: _obs.Histogram(n, labels,          # noqa: E731
+                                            window=_HIST_WINDOW)
+            mk_g = lambda n: _obs.Gauge(n, labels)              # noqa: E731
+        self._c = {k: mk_c(f'gen.requests_{k}') for k in
+                   ('submitted', 'completed', 'rejected', 'expired',
+                    'failed')}
+        self._c['evictions'] = mk_c('gen.evictions')
+        self._c['tokens'] = mk_c('gen.tokens')
+        self._h = {'prefill': mk_h('gen.prefill_ms'),
+                   'step': mk_h('gen.decode_step_ms'),
+                   'ttft': mk_h('gen.ttft_ms')}
+        self._g = {'occupancy': mk_g('gen.slot_occupancy'),
+                   'pages': mk_g('gen.page_utilization')}
+
+    def _note(self, key, n=1):
+        self._n[key] += n
+        c = self._c.get(key)
+        if c is not None:
+            c.inc(n)
+
+    def _update_gauges_locked(self):
+        active = sum(1 for s in self._slots if s is not None)
+        self._g['occupancy'].set(active / max(self.num_slots, 1))
+        usable = max(self.num_pages - 1, 1)
+        self._g['pages'].set(self._alloc.used_pages / usable)
+
+    # ---- compiled fns ----------------------------------------------------
+    def _build_fns(self):
+        cfg, fwd = self.config, self._forward_fn
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+
+        def sample_rows(lg, seeds, positions):
+            if temperature == 0:
+                # greedy: per-row argmax, batch-composition independent
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+            def one(row, seed, p):
+                # the key depends only on (seed, input position): a
+                # restarted/evicted sequence regenerates identical tokens
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), p)
+                return _gpt._sample(row[None], temperature, top_k, top_p,
+                                    key=key)[0]
+            return jax.vmap(one)(lg, seeds, positions)
+
+        def prefill(params, pool, prompt, valid, page_table, seed):
+            self._trace_count += 1      # trace-time side effect
+            cache = {'k': pool['k'], 'v': pool['v'],
+                     'page_table': page_table, 'valid': valid}
+            pos0 = jnp.zeros((prompt.shape[0],), jnp.int32)
+            logits, cache = fwd(params, prompt, cache, pos0, cfg,
+                                last_only=True)
+            tok = sample_rows(logits[:, 0], seed,
+                              valid.astype(jnp.int32) - 1)
+            return tok, {'k': cache['k'], 'v': cache['v']}
+
+        def step(params, pool, tok, pos, page_table, seeds):
+            self._trace_count += 1
+            cache = {'k': pool['k'], 'v': pool['v'],
+                     'page_table': page_table}
+            logits, cache = fwd(params, tok[:, None], cache, pos, cfg)
+            nxt = sample_rows(logits[:, 0], seeds, pos)
+            return nxt, {'k': cache['k'], 'v': cache['v']}
+
+        return (jax.jit(prefill, donate_argnums=(1,)),
+                jax.jit(step, donate_argnums=(1,)))
+
+    def _fns_pair(self):
+        if self._fns is None:
+            self._fns = self._build_fns()
+        return self._fns
+
+    def _manifest_entries(self):
+        from ..warmup.manifest import generation_entry
+        geom = dict(slots=self.num_slots, page_size=self.page_size,
+                    num_pages=self.num_pages,
+                    prefill_width=self.prefill_width,
+                    table_width=self.p_max)
+        return [generation_entry('gen_prefill', **geom),
+                generation_entry('gen_decode', **geom)]
+
+    def _maybe_record(self):
+        wm = sys.modules.get('paddle_tpu.warmup.manifest')
+        if wm is not None and wm.capturing():
+            for e in self._manifest_entries():
+                wm.record(e)
+
+    def warmup(self):
+        """AOT-compile the prefill and decode executables before traffic
+        (zero cold-start: a live call after this neither retraces nor
+        recompiles). Returns the prebuild report dict."""
+        from .. import warmup as _warmup_mod
+        man = _warmup_mod.Manifest()
+        for e in self._manifest_entries():
+            man.add(e)
+        return _warmup_mod.prebuild(man, generation=self)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError('engine already shut down')
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._scheduler_loop,
+                    name='paddle-tpu-generation-sched', daemon=True)
+                self._thread.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the scheduler. ``drain=True`` finishes every admitted and
+        queued sequence first; otherwise their futures fail with
+        EngineClosedError."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = drain
+            failed = []
+            if not drain:
+                failed = [r for r in self._queue]
+                self._queue.clear()
+                for i, slot in enumerate(self._slots):
+                    if slot is not None:
+                        failed.append(slot.req)
+                        self._free_slot_locked(i)
+            inline = drain and self._thread is None
+            self._cv.notify_all()
+        for r in failed:
+            if r.future._finish(EngineClosedError('engine shut down')):
+                self._note('failed')
+        if inline:
+            self._drain_inline()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # ---- admission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, deadline_ms=None, seed=0):
+        """Enqueue one sequence. ``prompt`` is a 1-D token id sequence of
+        length 1..prefill_width; returns a ``GenerationFuture``. Tokens
+        stop at ``eos_id`` (emitted), ``max_new_tokens``, or the context
+        window (a prompt of exactly max_seq_len still yields one token)."""
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        t0 = int(arr.size)
+        if not 1 <= t0 <= self.prefill_width:
+            raise ValueError(
+                f'prompt length {t0} outside [1, {self.prefill_width}] '
+                f'(prefill_width)')
+        if int(max_new_tokens) < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        # the final decode write lands at position max_seq_len-1; the +1 is
+        # the token sampled from that full-window step (same rule as
+        # GPTForCausalLM.generate's n_cached)
+        eff = min(int(max_new_tokens), self.max_seq_len - t0 + 1)
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else self.default_deadline_ms)
+        now = self._clock()
+        deadline_t = (now + deadline_ms / 1e3
+                      if deadline_ms is not None else None)
+        fut = GenerationFuture()
+        req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, now,
+                       deadline_t)
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError('engine already shut down')
+            if len(self._queue) >= self.queue_capacity:
+                self._note('rejected')
+                raise QueueFullError(self.queue_capacity, len(self._queue))
+            self._queue.append(req)
+            self._note('submitted')
+            self._cv.notify_all()
+        if self._autostart and self._thread is None:
+            self.start()
+        return fut
+
+    # ---- scheduler -------------------------------------------------------
+    def _scheduler_loop(self):
+        while True:
+            with self._cv:
+                while (not self._closed and not self._queue
+                       and not any(s is not None for s in self._slots)):
+                    self._cv.wait(0.05)
+                if self._closed:
+                    if not self._draining:
+                        return
+                    if (not self._queue
+                            and not any(s is not None for s in self._slots)):
+                        return
+                admitted = self._admit_locked()
+            for idx in admitted:
+                self._prefill_one(idx)
+            if any(s is not None for s in self._slots):
+                self._decode_step()
+
+    def _drain_inline(self):
+        """Finish all admitted+queued work on the caller's thread (used by
+        shutdown(drain=True) when no scheduler thread ever started)."""
+        while True:
+            with self._cv:
+                if (not self._queue
+                        and not any(s is not None for s in self._slots)):
+                    return
+                admitted = self._admit_locked()
+            for idx in admitted:
+                self._prefill_one(idx)
+            if any(s is not None for s in self._slots):
+                self._decode_step()
+
+    def _admit_locked(self):
+        out = []
+        while self._queue:
+            free_idx = next((i for i, s in enumerate(self._slots)
+                             if s is None), None)
+            if free_idx is None:
+                break
+            req = self._queue[0]
+            now = self._clock()
+            if req.deadline_t is not None and now > req.deadline_t:
+                self._queue.popleft()
+                waited = (now - req.enqueue_t) * 1e3
+                limit = (req.deadline_t - req.enqueue_t) * 1e3
+                if req.future._finish(DeadlineExceededError(waited, limit)):
+                    self._note('expired')
+                continue
+            need = _pkv.pages_for(len(req.prompt), self.page_size)
+            if need > self.num_pages - 1:
+                self._queue.popleft()
+                req.future._finish(ValueError(
+                    f'prompt needs {need} pages but the pool only has '
+                    f'{self.num_pages - 1} allocatable'))
+                self._note('failed')
+                continue
+            pages = self._alloc.alloc(need)
+            if pages is None:
+                break       # active slots will free pages; retry next round
+            self._queue.popleft()
+            table = np.zeros((self.p_max,), np.int32)
+            table[:need] = pages
+            self._slots[free_idx] = _Slot(req, table, self._admit_seq)
+            self._admit_seq += 1
+            out.append(free_idx)
+        if out:
+            self._update_gauges_locked()
+        return out
+
+    def _prefill_one(self, idx):
+        slot = self._slots[idx]
+        if slot is None:
+            return
+        req = slot.req
+        t0 = len(req.prompt)
+        prompt = np.zeros((1, self.prefill_width), np.int32)
+        prompt[0, :t0] = req.prompt
+        valid = np.asarray([t0], np.int32)
+        table = slot.table[None].copy()
+        seed = np.asarray([req.seed], np.uint32)
+        self._maybe_record()
+        pf = self._aot.get('gen_prefill') or self._fns_pair()[0]
+        wall0 = time.perf_counter()
+
+        def dev():
+            fault.inject('gen.step')
+            tok, pool = pf(self._params, self._pool, jnp.asarray(prompt),
+                           jnp.asarray(valid), jnp.asarray(table),
+                           jnp.asarray(seed))
+            return int(np.asarray(tok)[0]), pool
+
+        try:
+            with _obs.span('gen.prefill', slot=idx, prompt_len=t0):
+                tok, pool = self._breaker.call(dev)
+        except Exception as e:
+            self._handle_device_failure(e)
+            return
+        self._pool = pool
+        self._h['prefill'].observe(1e3 * (time.perf_counter() - wall0))
+        self._n['prefills'] += 1
+        with self._cv:
+            slot.last_tok = tok
+            self._emit_locked(slot, tok)
+            if self._slot_finished(slot, tok):
+                self._finish_slot_locked(idx)
+            self._update_gauges_locked()
+
+    def _decode_step(self):
+        s = self.num_slots
+        tok = np.zeros((s,), np.int32)
+        pos = np.zeros((s,), np.int32)
+        table = np.zeros((s, self.p_max), np.int32)
+        seeds = np.zeros((s,), np.uint32)
+        with self._cv:
+            self._ensure_pages_locked()
+            active = []
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                tok[i] = slot.last_tok
+                pos[i] = slot.pos
+                table[i] = slot.table
+                seeds[i] = slot.req.seed
+                active.append(i)
+        if not active:
+            return
+        self._maybe_record()
+        st = self._aot.get('gen_decode') or self._fns_pair()[1]
+        wall0 = time.perf_counter()
+
+        def dev():
+            fault.inject('gen.step')
+            nxt, pool = st(self._params, self._pool, jnp.asarray(tok),
+                           jnp.asarray(pos), jnp.asarray(table),
+                           jnp.asarray(seeds))
+            # ONE host readback per iteration for every slot
+            return np.asarray(nxt), pool
+
+        try:
+            with _obs.span('gen.decode_step', slots=len(active)):
+                nxt, pool = self._breaker.call(dev)
+        except Exception as e:
+            self._handle_device_failure(e)
+            return
+        self._pool = pool
+        self._h['step'].observe(1e3 * (time.perf_counter() - wall0))
+        self._n['steps'] += 1
+        with self._cv:
+            for i in active:
+                slot = self._slots[i]
+                if slot is None:        # evicted between snapshot and here
+                    continue
+                t = int(nxt[i])
+                slot.pos += 1
+                slot.last_tok = t
+                self._emit_locked(slot, t)
+                if self._slot_finished(slot, t):
+                    self._finish_slot_locked(i)
+            self._update_gauges_locked()
+            self._cv.notify_all()
+
+    # ---- slot state (all called under the lock) --------------------------
+    def _emit_locked(self, slot, tok):
+        req = slot.req
+        idx = slot.produced
+        slot.produced += 1
+        self._note('tokens')
+        if idx >= req.future._count():
+            req.future._append(tok)
+            if not req.ttft_noted:
+                req.ttft_noted = True
+                self._h['ttft'].observe(
+                    1e3 * (self._clock() - req.enqueue_t))
+
+    def _slot_finished(self, slot, tok):
+        if self.eos_id is not None and tok == self.eos_id:
+            return True
+        if slot.produced >= slot.req.eff_max_new:
+            return True
+        return slot.pos >= self.max_seq_len
+
+    def _free_slot_locked(self, idx):
+        slot = self._slots[idx]
+        pages = [int(p) for p in slot.table if p != _pkv.TRASH_PAGE]
+        if pages:
+            self._alloc.free(pages)
+        self._slots[idx] = None
+
+    def _finish_slot_locked(self, idx):
+        slot = self._slots[idx]
+        self._free_slot_locked(idx)
+        if slot.req.future._finish():
+            self._note('completed')
+        self._cv.notify_all()
+
+    def _ensure_pages_locked(self):
+        """Allocate the next page for any slot crossing a page boundary.
+        On pool exhaustion, evict the most-recently-admitted active slot —
+        INCLUDING the requester itself (self-preemption). The oldest
+        active sequence is therefore never a victim: it monotonically
+        advances, finishes, and frees its pages, which bounds every other
+        sequence's wait (the no-livelock invariant — evicting "the other
+        slot" instead lets two growing sequences destroy each other's
+        progress forever). An evicted request requeues at the FRONT and
+        later regenerates identical tokens from its seeded keys."""
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            li = slot.pos // self.page_size
+            if li >= self.p_max or slot.table[li] != _pkv.TRASH_PAGE:
+                continue
+            while True:
+                pg = self._alloc.alloc(1)
+                if pg is not None:
+                    slot.table[li] = pg[0]
+                    break
+                victim = self._pick_victim_locked()
+                only = sum(1 for s in self._slots if s is not None) == 1
+                if victim == i and only:
+                    # alone and exhausted: this request's total demand
+                    # exceeds the whole pool — retrying cannot succeed
+                    self._free_slot_locked(i)
+                    if slot.req.future._finish(RuntimeError(
+                            f'request needs more KV pages than the pool '
+                            f'holds ({self.num_pages - 1} allocatable)')):
+                        self._note('failed')
+                    break
+                self._evict_locked(victim)
+                if victim == i:
+                    break       # self-preempted; re-admitted when pages free
+            # fall through to the next slot whether or not i survived
+
+    def _pick_victim_locked(self):
+        best, best_seq = None, -1
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.admit_seq > best_seq:
+                best, best_seq = i, slot.admit_seq
+        return best
+
+    def _evict_locked(self, idx):
+        slot = self._slots[idx]
+        req = slot.req
+        self._free_slot_locked(idx)
+        req.evictions += 1
+        self._note('evictions')
+        # FRONT of the queue: an evicted sequence restarts before any new
+        # arrival — bounded starvation, deterministic regeneration
+        self._queue.appendleft(req)
+
+    def _handle_device_failure(self, exc):
+        """A failed device call may have consumed the donated pool: fail
+        every active sequence, release their pages, rebuild the pool."""
+        with self._cv:
+            failed = []
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    failed.append(slot.req)
+                    self._free_slot_locked(i)
+            self._pool = _gpt.init_paged_kv_cache(
+                self.config, self.num_pages, self.page_size)
+            self._update_gauges_locked()
+            self._cv.notify_all()
+        for r in failed:
+            if r.future._finish(exc):
+                self._note('failed')
+
+    # ---- observability ---------------------------------------------------
+    def stats(self):
+        elapsed = max(self._clock() - self._start_t, 1e-9)
+
+        def pct(h, q):
+            v = h.percentile(q)
+            return round(v, 3) if v is not None else 0.0
+
+        with self._lock:
+            active = sum(1 for s in self._slots if s is not None)
+            depth = len(self._queue)
+            free_pages = self._alloc.free_pages
+        out = dict(self._n)
+        out.update({
+            'active_slots': active,
+            'queue_depth': depth,
+            'free_pages': free_pages,
+            'num_slots': self.num_slots,
+            'page_size': self.page_size,
+            'num_pages': self.num_pages,
+            'prefill_width': self.prefill_width,
+            'traces': self._trace_count,
+            'tokens_per_sec': round(self._n['tokens'] / elapsed, 2),
+            'prefill_ms_p50': pct(self._h['prefill'], 50),
+            'prefill_ms_p99': pct(self._h['prefill'], 99),
+            'decode_step_ms_p50': pct(self._h['step'], 50),
+            'decode_step_ms_p99': pct(self._h['step'], 99),
+            'ttft_ms_p50': pct(self._h['ttft'], 50),
+            'ttft_ms_p99': pct(self._h['ttft'], 99),
+            'circuit_state': self._breaker.state,
+            'uptime_s': round(elapsed, 3),
+        })
+        return out

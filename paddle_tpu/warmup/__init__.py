@@ -34,8 +34,8 @@ without code changes (picked up by the serving engine and hapi Model).
 """
 from .manifest import (Manifest, array_sig, capture, capture_start,  # noqa: F401
                        capture_stop, capturing, eval_step_entry,
-                       predictor_entry, record, serving_bucket_entry,
-                       train_step_entry)
+                       generation_entry, predictor_entry, record,
+                       serving_bucket_entry, train_step_entry)
 from .persistent import (ENV_CACHE_DIR, cache_key_component,  # noqa: F401
                          cache_stats, disable_persistent_cache,
                          enable_persistent_cache, ensure_persistent_cache,
@@ -45,7 +45,7 @@ from .prebuild import all_buckets_manifest, prebuild  # noqa: F401
 __all__ = [
     'Manifest', 'capture', 'capture_start', 'capture_stop', 'capturing',
     'record', 'array_sig', 'serving_bucket_entry', 'train_step_entry',
-    'eval_step_entry', 'predictor_entry',
+    'eval_step_entry', 'predictor_entry', 'generation_entry',
     'enable_persistent_cache', 'disable_persistent_cache',
     'ensure_persistent_cache', 'persistent_cache_dir', 'cache_stats',
     'cache_key_component', 'ENV_CACHE_DIR',

@@ -28,7 +28,7 @@ import threading
 MANIFEST_VERSION = 1
 
 ENTRY_KINDS = ('serving_bucket', 'train_step', 'accum_step', 'eval_step',
-               'predictor')
+               'predictor', 'gen_prefill', 'gen_decode')
 
 
 def _sig_to_json(sig):
@@ -76,6 +76,21 @@ def predictor_entry(shapes_key, precision='float32'):
     Predictor.run compiles for (full shapes incl. batch dim)."""
     return {'kind': 'predictor', 'inputs': _sig_to_json(shapes_key),
             'precision': str(precision)}
+
+
+def generation_entry(kind, *, slots, page_size, num_pages, prefill_width,
+                     table_width):
+    """One GenerationEngine executable (``gen_prefill`` or ``gen_decode``):
+    the geometry fields pin the batch-independent shapes of the continuous-
+    batching prefill/step programs, so prebuild can verify the replaying
+    engine was built with the same slot/page layout."""
+    if kind not in ('gen_prefill', 'gen_decode'):
+        raise ValueError(f'kind must be gen_prefill or gen_decode, '
+                         f'got {kind!r}')
+    return {'kind': kind, 'slots': int(slots), 'page_size': int(page_size),
+            'num_pages': int(num_pages),
+            'prefill_width': int(prefill_width),
+            'table_width': int(table_width)}
 
 
 class Manifest:

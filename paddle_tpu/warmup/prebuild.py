@@ -20,6 +20,10 @@ Entry dispatch:
 - ``eval_step`` → ``model=``: compile the eval/predict step.
 - ``predictor`` → ``predictor=``: compile the padded-feed executable and
   seed ``Predictor._compiled``.
+- ``gen_prefill`` / ``gen_decode`` → ``generation=``: compile the
+  continuous-batching GenerationEngine's two executables (fixed-slot
+  decode step + padded batch-1 prefill) after verifying the manifest's
+  slot/page geometry matches the live engine.
 
 Entries with no matching target are counted ``untargeted`` and skipped;
 stale entries (shapes the current network can no longer trace) are warned
@@ -151,6 +155,50 @@ def _prebuild_eval(model, entry):
     return True
 
 
+def _prebuild_generation(engine, entry):
+    """AOT-compile one GenerationEngine executable (gen_prefill/gen_decode).
+    The manifest's geometry must match the live engine — a mismatched
+    entry is stale (caught by the strict/skip machinery), never silently
+    compiled at the wrong shapes."""
+    kind = entry['kind']
+    geom = {'slots': engine.num_slots, 'page_size': engine.page_size,
+            'num_pages': engine.num_pages,
+            'prefill_width': engine.prefill_width,
+            'table_width': engine.p_max}
+    for k, v in geom.items():
+        got = int(entry.get(k, v))
+        if got != v:
+            raise ValueError(
+                f'generation entry {k}={got} does not match the live '
+                f'engine ({k}={v})')
+    if kind in engine._aot:
+        return False
+    pf, st = engine._fns_pair()
+    params = _tree_structs(engine._params)
+    pool = _tree_structs(engine._pool)
+    if kind == 'gen_prefill':
+        compiled = pf.lower(
+            params, pool,
+            _struct((1, engine.prefill_width), np.int32),
+            _struct((1,), np.int32),
+            _struct((1, engine.p_max), np.int32),
+            _struct((1,), np.uint32)).compile()
+        _perf_analyze('gen.prefill', compiled)
+    else:
+        s = engine.num_slots
+        compiled = st.lower(
+            params, pool,
+            _struct((s,), np.int32), _struct((s,), np.int32),
+            _struct((s, engine.p_max), np.int32),
+            _struct((s,), np.uint32)).compile()
+        _perf_analyze('gen.decode', compiled)
+    # hand the AOT executable to the engine's live path: jit's own call
+    # cache would rebuild the executable on the first real invocation
+    # even with the trace warm, costing one full XLA compile per fn
+    engine._aot[kind] = compiled
+    return True
+
+
 def _prebuild_predictor(predictor, entry):
     key = _sig_from_json(entry['inputs'])
     fn = predictor._compiled.get(key)
@@ -169,7 +217,7 @@ def _prebuild_predictor(predictor, entry):
 # ---- driver ----------------------------------------------------------------
 
 def prebuild(manifest, *, engine=None, model=None, predictor=None,
-             strict=False):
+             generation=None, strict=False):
     """Replay ``manifest`` (a Manifest or a path to one) against the given
     targets. Returns a report dict: entries / prebuilt / already_cached /
     skipped / untargeted / total_ms (+ ``skips`` reasons).
@@ -188,6 +236,11 @@ def prebuild(manifest, *, engine=None, model=None, predictor=None,
         handlers['eval_step'] = lambda e: _prebuild_eval(model, e)
     if predictor is not None:
         handlers['predictor'] = lambda e: _prebuild_predictor(predictor, e)
+    if generation is not None:
+        handlers['gen_prefill'] = \
+            lambda e: _prebuild_generation(generation, e)
+        handlers['gen_decode'] = \
+            lambda e: _prebuild_generation(generation, e)
 
     # Prebuild flips the network's train/eval mode to trace each step kind;
     # put it back so a live fit/eval after warmup starts where it left off.

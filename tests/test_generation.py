@@ -1,0 +1,432 @@
+"""Continuous batching + paged KV cache (ISSUE 7): page allocator /
+paged-write plumbing, paged-vs-dense decode parity (gpt, moe_gpt, int8
+KV), the Pallas paged-attention kernel in interpret mode, and the
+GenerationEngine's scheduling behaviors — EOS, cache-filling prompts,
+mid-stream admission determinism, eviction/readmission, streaming,
+warmup zero-retrace, admission control, and gen.* telemetry."""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import observability as obs
+from paddle_tpu.models import DecodeFnCache, clear_decode_caches
+from paddle_tpu.models import gpt, moe_gpt
+from paddle_tpu.ops import paged_kv
+from paddle_tpu.serving import (DeadlineExceededError, GenerationEngine,
+                                QueueFullError)
+
+# ops/__init__ rebinds `flash_attention` to the FUNCTION, shadowing the
+# submodule for attribute-style imports — importlib reaches the module
+fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+pa = importlib.import_module('paddle_tpu.ops.paged_attention')
+
+pytestmark = pytest.mark.gen
+
+# max_seq_len 32 with page_size 8 -> p_max 4: the virtual cache length
+# (p_max * ps = 32) equals the dense S_max, the precondition for bitwise
+# fallback parity at matched shapes
+CFG = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=32, dtype='float32', remat=False,
+                    use_flash=False)
+PS = 8
+
+
+@pytest.fixture(scope='module')
+def params():
+    return gpt.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompts(lens, seed=0, vocab=None):
+    rng = np.random.RandomState(seed)
+    v = vocab or CFG.vocab_size
+    return [rng.randint(0, v, size=t).astype(np.int32) for t in lens]
+
+
+def _dense_greedy(params, cfg, prompt, n_new):
+    """Reference: dense-cache greedy decode of ONE sequence."""
+    cache = gpt.init_kv_cache(cfg, 1)
+    logits, cache = gpt.forward_with_cache(
+        params, jnp.asarray(prompt[None]), cache, 0, cfg, last_only=True)
+    toks = [int(jnp.argmax(logits[0, -1]))]
+    pos = len(prompt)
+    for _ in range(n_new - 1):
+        lg, cache = gpt.forward_with_cache(
+            params, jnp.asarray([[toks[-1]]], jnp.int32), cache, pos, cfg)
+        toks.append(int(jnp.argmax(lg[0, -1])))
+        pos += 1
+    return toks
+
+
+def _paged_greedy_batch(params, cfg, prompts, n_new, ps=PS,
+                        fwd=gpt.forward_with_cache):
+    """Greedy-decode a ragged batch through the paged cache directly (no
+    engine): one padded prefill with per-slot `valid`, then batched
+    single-token steps at per-slot positions."""
+    b = len(prompts)
+    p_max = paged_kv.pages_for(cfg.max_seq_len, ps)
+    pool = gpt.init_paged_kv_cache(cfg, b * p_max + 1, ps)
+    alloc = paged_kv.PageAllocator(b * p_max + 1)
+    table = np.zeros((b, p_max), np.int32)
+    for i in range(b):
+        table[i] = alloc.alloc(p_max)
+    w = max(len(p) for p in prompts)
+    toks_in = np.zeros((b, w), np.int32)
+    valid = np.zeros((b,), np.int32)
+    for i, p in enumerate(prompts):
+        toks_in[i, :len(p)] = p
+        valid[i] = len(p)
+    cache = {'k': pool['k'], 'v': pool['v'],
+             'page_table': jnp.asarray(table), 'valid': jnp.asarray(valid)}
+    logits, cache = fwd(params, jnp.asarray(toks_in), cache,
+                        jnp.zeros((b,), jnp.int32), cfg, last_only=True)
+    out = [[int(jnp.argmax(logits[i, 0]))] for i in range(b)]
+    cache = {'k': cache['k'], 'v': cache['v'],
+             'page_table': cache['page_table']}      # decode: no padding
+    pos = valid.copy()
+    for _ in range(n_new - 1):
+        step_in = np.asarray([[o[-1]] for o in out], np.int32)
+        lg, cache = fwd(params, jnp.asarray(step_in), cache,
+                        jnp.asarray(pos), cfg)
+        for i in range(b):
+            out[i].append(int(jnp.argmax(lg[i, 0])))
+        pos += 1
+    return out, logits
+
+
+# ---------------------------------------------------------------------------
+# paged-KV plumbing
+# ---------------------------------------------------------------------------
+
+def test_pages_for_and_allocator():
+    assert paged_kv.pages_for(1, 8) == 1
+    assert paged_kv.pages_for(8, 8) == 1
+    assert paged_kv.pages_for(9, 8) == 2
+    assert paged_kv.pages_for(32, 8) == 4
+    a = paged_kv.PageAllocator(5)           # page 0 reserved
+    assert a.free_pages == 4
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3
+    assert paged_kv.TRASH_PAGE not in got   # trash page never handed out
+    assert a.alloc(2) is None               # all-or-nothing
+    assert a.free_pages == 1
+    a.free(got[:2])
+    assert a.free_pages == 3
+    assert sorted(a.alloc(3)) == sorted(got[:2] + [4]) or a.free_pages == 0
+
+
+def test_paged_write_gather_roundtrip():
+    rng = np.random.RandomState(1)
+    n, ps, h, d, b = 6, 4, 2, 8, 2
+    pool = jnp.zeros((n, ps, h, d), jnp.float32)
+    # deliberately scattered, non-contiguous physical pages
+    table = jnp.asarray([[3, 1, 0, 0], [5, 2, 4, 0]], jnp.int32)
+    rows = jnp.asarray(rng.randn(b, 6, h, d), jnp.float32)
+    valid = jnp.asarray([5, 6], jnp.int32)   # slot 0 row 5 is padding
+    pool = paged_kv.paged_write(pool, rows, table, jnp.zeros((b,), jnp.int32),
+                                valid)
+    virt = paged_kv.gather_virtual(pool, table)
+    assert virt.shape == (b, ps * table.shape[1], h, d)
+    np.testing.assert_array_equal(np.asarray(virt[0, :5]),
+                                  np.asarray(rows[0, :5]))
+    np.testing.assert_array_equal(np.asarray(virt[1, :6]),
+                                  np.asarray(rows[1, :6]))
+    # the padding row landed in the trash page, not slot 0's virtual cache
+    np.testing.assert_array_equal(np.asarray(virt[0, 5]),
+                                  np.zeros((h, d), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# paged-vs-dense decode parity
+# ---------------------------------------------------------------------------
+
+def test_paged_vs_dense_parity_gpt_ragged(params):
+    prompts = _prompts([5, 8])
+    want = [_dense_greedy(params, CFG, p, 6) for p in prompts]
+    got, _ = _paged_greedy_batch(params, CFG, prompts, 6)
+    assert got == want
+
+
+def test_paged_vs_dense_bitwise_at_matched_shape(params):
+    # equal-length prompts, prefill width == T0, same batch: the fallback
+    # runs the exact op sequence of the dense path -> bitwise logits
+    prompts = _prompts([8, 8], seed=3)
+    dense = gpt.init_kv_cache(CFG, 2)
+    dlg, _ = gpt.forward_with_cache(
+        params, jnp.asarray(np.stack(prompts)), dense, 0, CFG,
+        last_only=True)
+    _, plg = _paged_greedy_batch(params, CFG, prompts, 1)
+    np.testing.assert_array_equal(np.asarray(dlg), np.asarray(plg))
+
+
+def test_paged_vs_dense_parity_moe():
+    mcfg = moe_gpt.MoEConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                             num_heads=2, n_experts=4, max_seq_len=32,
+                             dtype='float32', remat=False, use_flash=False,
+                             capacity_factor=8.0)
+    mp = moe_gpt.init_params(mcfg, jax.random.PRNGKey(1))
+    prompts = _prompts([4, 7], seed=5)
+
+    def dense_one(prompt, n_new):
+        cache = gpt.init_kv_cache(mcfg, 1)
+        lg, cache = moe_gpt.forward_with_cache(
+            mp, jnp.asarray(prompt[None]), cache, 0, mcfg, last_only=True)
+        toks = [int(jnp.argmax(lg[0, -1]))]
+        pos = len(prompt)
+        for _ in range(n_new - 1):
+            lg, cache = moe_gpt.forward_with_cache(
+                mp, jnp.asarray([[toks[-1]]], jnp.int32), cache, pos, mcfg)
+            toks.append(int(jnp.argmax(lg[0, -1])))
+            pos += 1
+        return toks
+
+    want = [dense_one(p, 5) for p in prompts]
+    got, _ = _paged_greedy_batch(mp, mcfg, prompts, 5,
+                                 fwd=moe_gpt.forward_with_cache)
+    assert got == want
+
+
+def test_paged_vs_dense_parity_int8_kv(params):
+    icfg = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, max_seq_len=32, dtype='float32',
+                         remat=False, use_flash=False, kv_cache_int8=True)
+    prompts = _prompts([6, 8], seed=7)
+    want = [_dense_greedy(params, icfg, p, 5) for p in prompts]
+    got, _ = _paged_greedy_batch(params, icfg, prompts, 5)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged-attention kernel (interpret mode)
+# ---------------------------------------------------------------------------
+
+def _kernel_setup(int8=False, seed=0):
+    rng = np.random.RandomState(seed)
+    b, t, h, d, ps, p_max = 2, 1, 2, 64, 128, 2
+    n = b * p_max + 1
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32) * 0.3
+    pos = jnp.asarray([130, 200], jnp.int32)
+    table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    kv = [jnp.asarray(rng.randn(b, 256, h, d), jnp.float32) * 0.3
+          for _ in range(2)]
+    pools = []
+    for rows in kv:
+        pool = jnp.zeros((n, ps, h, d), jnp.float32)
+        if int8:
+            pool = {'int8': jnp.zeros((n, ps, h, d), jnp.int8),
+                    'scale': jnp.zeros((n, ps, h), jnp.float32)}
+        pools.append(paged_kv.paged_write(pool, rows, table,
+                                          jnp.zeros((b,), jnp.int32)))
+    return q, pools[0], pools[1], table, pos
+
+
+@pytest.mark.parametrize('int8', [False, True])
+def test_paged_kernel_interpret_parity(int8):
+    q, kp, vp, table, pos = _kernel_setup(int8=int8)
+    k_arr = kp['int8'] if int8 else kp
+    fa.set_interpret(True)
+    try:
+        assert pa.paged_attention_available(q, k_arr)
+        if int8:
+            got = pa.paged_flash_decode_int8(q, kp, vp, table, pos)
+        else:
+            got = pa.paged_flash_decode(q, kp, vp, table, pos)
+    finally:
+        fa.set_interpret(False)
+    want = pa.paged_attention_fallback(q, kp, vp, table, pos, jnp.float32)
+    rtol = 2e-2 if int8 else 2e-5
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=rtol, atol=rtol)
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine
+# ---------------------------------------------------------------------------
+
+def _engine(params, cfg=CFG, **kw):
+    kw.setdefault('num_slots', 2)
+    kw.setdefault('page_size', PS)
+    kw.setdefault('prefill_width', 16)
+    return GenerationEngine(params, cfg, **kw)
+
+
+def test_engine_greedy_matches_dense_reference(params):
+    prompts = _prompts([5, 9, 3, 12], seed=11)
+    want = [_dense_greedy(params, CFG, p, 6) for p in prompts]
+    with _engine(params) as eng:
+        futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    assert got == want
+
+
+def test_prompt_exactly_fills_cache(params):
+    # a prompt of max_seq_len still yields exactly ONE token: the final
+    # decode write would fall outside the window, but the prefill's own
+    # last-row logits are valid
+    prompt = _prompts([CFG.max_seq_len], seed=13)[0]
+    with _engine(params, prefill_width=CFG.max_seq_len) as eng:
+        fut = eng.submit(prompt, max_new_tokens=8)
+        toks = fut.result(timeout=120)
+    assert len(toks) == 1
+    dlg, _ = gpt.forward_with_cache(
+        params, jnp.asarray(prompt[None]), gpt.init_kv_cache(CFG, 1), 0,
+        CFG, last_only=True)
+    assert toks[0] == int(jnp.argmax(dlg[0, -1]))
+
+
+def test_per_sequence_eos_inside_batch(params):
+    prompts = _prompts([5, 9], seed=17)
+    base = [_dense_greedy(params, CFG, p, 8) for p in prompts]
+    eos = base[0][2]        # learned from the greedy stream, not guessed
+    assert eos not in base[1][:3]
+    with _engine(params, eos_id=eos) as eng:
+        futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    # each sequence truncates at (and emits) ITS OWN first EOS, or runs
+    # the full budget — batch-mates are independent
+    def trunc(stream):
+        return stream[:stream.index(eos) + 1] if eos in stream else stream
+
+    assert got[0] == trunc(base[0])
+    assert got[1] == trunc(base[1])
+    assert len(got[0]) < len(base[0])   # the EOS actually truncated seq 0
+
+
+def test_mid_stream_admission_determinism(params):
+    # seeded sampling: a request admitted while others are mid-decode
+    # produces the same tokens as the same request alone in an engine of
+    # the same geometry (batch composition independence)
+    prompts = _prompts([5, 9, 7], seed=19)
+    kw = dict(temperature=0.8, top_k=20)
+    with _engine(params, **kw) as eng:
+        futs = [eng.submit(p, max_new_tokens=6, seed=i)
+                for i, p in enumerate(prompts)]
+        batched = [f.result(timeout=120) for f in futs]
+    for i, p in enumerate(prompts):
+        with _engine(params, **kw) as eng:
+            alone = eng.submit(p, max_new_tokens=6, seed=i).result(timeout=120)
+        assert alone == batched[i], f'sequence {i} diverged'
+
+
+def test_eviction_determinism_and_no_duplicates(params):
+    # pool too small for both sequences' full demand: evictions must fire,
+    # and every stream must still equal the unconstrained run with no
+    # token re-emitted
+    prompts = _prompts([9, 9], seed=23)
+    n_new = 16
+    want = [_dense_greedy(params, CFG, p, n_new) for p in prompts]
+    with _engine(params, num_pages=6) as eng:
+        futs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        streams = [list(f.stream(timeout=120)) for f in futs]
+        stats = eng.stats()
+    assert stats['evictions'] >= 1
+    assert streams == want
+    assert all(len(s) == n_new for s in streams)
+
+
+def test_streaming_matches_result(params):
+    prompt = _prompts([6], seed=29)[0]
+    with _engine(params) as eng:
+        fut = eng.submit(prompt, max_new_tokens=5)
+        streamed = list(fut.stream(timeout=120))
+        assert streamed == fut.result()
+        assert fut.done()
+
+
+def test_warmup_two_traces_and_zero_retrace(params):
+    eng = _engine(params, autostart=False)
+    report = eng.warmup()
+    assert report['prebuilt'] == 2
+    assert eng._trace_count == 2
+    assert set(eng._aot) == {'gen_prefill', 'gen_decode'}
+    # a second warmup finds both executables already built
+    assert eng.warmup()['already_cached'] == 2
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=4)
+                for p in _prompts([5, 9], seed=31)]
+        for f in futs:
+            f.result(timeout=120)
+    assert eng._trace_count == 2        # live traffic retraced nothing
+
+
+def test_manifest_capture_records_generation_entries(params):
+    from paddle_tpu import warmup
+    eng = _engine(params)
+    try:
+        with warmup.capture() as man:
+            eng.submit(_prompts([5])[0], max_new_tokens=2).result(timeout=120)
+        kinds = {e['kind'] for e in man}
+        assert {'gen_prefill', 'gen_decode'} <= kinds
+        entry = next(e for e in man if e['kind'] == 'gen_decode')
+        assert entry['slots'] == eng.num_slots
+        assert entry['page_size'] == eng.page_size
+        # a fresh engine of the same geometry prebuilds from the capture
+        eng2 = _engine(params, autostart=False)
+        report = warmup.prebuild(man, generation=eng2)
+        assert report['prebuilt'] == 2 and report['skipped'] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_queue_full_and_deadline(params):
+    eng = _engine(params, autostart=False, queue_capacity=2)
+    p = _prompts([4])[0]
+    eng.submit(p, max_new_tokens=2)
+    eng.submit(p, max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        eng.submit(p, max_new_tokens=2)
+    eng.shutdown(drain=False)
+    eng2 = _engine(params, autostart=False)
+    fut = eng2.submit(p, max_new_tokens=2, deadline_ms=0)
+    eng2.shutdown()                     # inline drain: expires the request
+    assert isinstance(fut.exception(timeout=10), DeadlineExceededError)
+
+
+def test_prompt_validation(params):
+    eng = _engine(params, autostart=False)
+    try:
+        with pytest.raises(ValueError):
+            eng.submit(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError):
+            eng.submit(np.zeros((eng.prefill_width + 1,), np.int32))
+        with pytest.raises(ValueError):
+            eng.submit(_prompts([4])[0], max_new_tokens=0)
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_gen_metrics_present(params):
+    with _engine(params) as eng:
+        eng.submit(_prompts([5], seed=37)[0], max_new_tokens=3).result(
+            timeout=120)
+        stats = eng.stats()
+    assert stats['completed'] == 1
+    assert stats['tokens'] == 3
+    assert stats['traces'] == 2
+    snap = obs.snapshot()
+    names = set(snap.get('counters', {})) | set(snap.get('histograms', {}))
+    for want in ('gen.requests_submitted', 'gen.requests_completed',
+                 'gen.tokens', 'gen.decode_step_ms', 'gen.ttft_ms'):
+        assert any(k.startswith(want) for k in names), want
+
+
+# ---------------------------------------------------------------------------
+# decode-fn cache satellite
+# ---------------------------------------------------------------------------
+
+def test_decode_fn_cache_bounds_and_clear():
+    built = []
+    c = DecodeFnCache(maxsize=2, name='t')
+    for key in ('a', 'b', 'a', 'c'):       # 'c' evicts LRU 'b'
+        c.get(key, lambda k=key: built.append(k) or k)
+    assert built == ['a', 'b', 'c']
+    assert 'a' in c and 'c' in c and 'b' not in c
+    assert len(c) == 2
+    clear_decode_caches()
+    assert len(c) == 0
+    assert DecodeFnCache(maxsize=0).maxsize > 0   # 0/None -> default size
+    with pytest.raises(ValueError):
+        DecodeFnCache(maxsize=-1)

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""CPU-safe decode benchmark: continuous batching vs request-at-a-time.
+
+Drives the SAME stream of ragged LLM generation requests (Poisson
+arrivals, varying prompt lengths) through two decode paths over the SAME
+GPT weights and prints ONE json line:
+
+  - ``cb``: serving.GenerationEngine — iteration-level batching over a
+    paged KV cache; all in-flight sequences advance together through ONE
+    compiled fixed-slot decode step, admissions fill slots between steps.
+  - ``rr``: request-at-a-time — the pre-engine status quo: each request's
+    batch-1 ``make_decode_fns`` prefill + per-token step loop runs to
+    completion before the next request starts (head-of-line blocking).
+
+Both paths are warmed first so compile time is excluded from the timed
+window. The engine side must prove the compile discipline: exactly one
+prefill + one decode executable (``traces == 2`` via the engine's
+trace-counter) and zero additional traces after the warmup replay.
+Greedy decoding lets the harness also assert token parity between the
+paged engine and the dense baseline.
+
+The rr side's queueing is computed analytically from measured per-request
+service times over the same arrival schedule (deterministic M/D/1-style
+replay) — wall-clock sleeps would only add noise to the identical
+arithmetic.
+
+Usage: python tools/decode_bench.py [--requests N] [--slots S]
+                                    [--max-new T] [--rate-ms MS]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+VOCAB, HIDDEN, LAYERS, HEADS, SEQ = 512, 128, 2, 2, 256
+PREFILL_W, PAGE = 64, 32
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = max(0, min(len(xs) - 1, int(round(q / 100.0 * len(xs) + 0.5)) - 1))
+    return xs[idx]
+
+
+def _requests(n, max_new, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(8, PREFILL_W + 1, size=n)
+    return [rng.randint(0, VOCAB, size=int(t)).astype(np.int32)
+            for t in lens], [int(max_new)] * n
+
+
+def run_bench(requests=8, slots=8, max_new=32, rate_ms=25.0, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationEngine
+
+    cfg = gpt.GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                        num_layers=LAYERS, num_heads=HEADS,
+                        max_seq_len=SEQ, dtype='float32', remat=False,
+                        use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(seed))
+    prompts, max_news = _requests(requests, max_new, seed=seed)
+    rng = np.random.RandomState(seed + 1)
+    gaps = rng.exponential(rate_ms / 1e3, size=requests)
+    arrivals = np.concatenate([[0.0], np.cumsum(gaps[:-1])])
+
+    # ---- rr baseline: batch-1 prefill + per-token step, serialized -------
+    prefill, step = gpt.make_decode_fns(cfg)
+
+    def rr_serve(prompt, n_new):
+        cache = gpt.init_kv_cache(cfg, 1)
+        t0 = time.perf_counter()
+        logits, cache = prefill(params, jnp.asarray(prompt[None]), cache)
+        toks = [int(jnp.argmax(logits, -1)[0])]
+        t_first = time.perf_counter()
+        pos = len(prompt)
+        for _ in range(n_new - 1):
+            lg, cache = step(params, jnp.asarray([toks[-1]], jnp.int32),
+                             jnp.int32(pos), cache)
+            toks.append(int(jnp.argmax(lg, -1)[0]))
+            pos += 1
+        return toks, t_first - t0, time.perf_counter() - t0
+
+    # warm every distinct prompt length's prefill (and the step) so the
+    # timed rr pass is compile-free, same as the engine side
+    for t in sorted({len(p) for p in prompts}):
+        rr_serve(np.zeros((t,), np.int32), 2)
+
+    rr_tokens, rr_ttft, t_cursor = [], [], 0.0
+    rr_total_tokens = 0
+    for arr_t, prompt, n_new in zip(arrivals, prompts, max_news):
+        toks, d_first, d_total = rr_serve(prompt, n_new)
+        start = max(t_cursor, arr_t)           # head-of-line queueing
+        rr_ttft.append((start + d_first - arr_t) * 1e3)
+        t_cursor = start + d_total
+        rr_tokens.append(toks)
+        rr_total_tokens += len(toks)
+    rr_span = t_cursor - arrivals[0]
+    rr_tps = rr_total_tokens / rr_span if rr_span > 0 else 0.0
+
+    # ---- continuous batching ---------------------------------------------
+    engine = GenerationEngine(params, cfg, num_slots=slots,
+                              page_size=PAGE, prefill_width=PREFILL_W,
+                              queue_capacity=max(64, requests))
+    engine.warmup()
+    traces_after_warmup = engine._trace_count
+
+    t_start = time.perf_counter()
+    futs, submit_t = [], []
+    for arr_t, prompt, n_new in zip(arrivals, prompts, max_news):
+        now = time.perf_counter() - t_start
+        if arr_t > now:
+            time.sleep(arr_t - now)
+        submit_t.append(time.perf_counter())
+        futs.append(engine.submit(prompt, max_new_tokens=n_new))
+    cb_tokens, cb_ttft = [], []
+    cb_total_tokens = 0
+    t_end = t_start
+    for fut, t_sub in zip(futs, submit_t):
+        stream_toks = []
+        for tok in fut.stream(timeout=600):
+            stream_toks.append(tok)
+            if len(stream_toks) == 1:
+                cb_ttft.append((time.perf_counter() - t_sub) * 1e3)
+        cb_tokens.append(stream_toks)
+        t_end = max(t_end, time.perf_counter())
+    cb_span = t_end - t_start
+    cb_tps = cb_total_tokens = sum(len(t) for t in cb_tokens)
+    cb_tps = cb_total_tokens / cb_span if cb_span > 0 else 0.0
+    stats = engine.stats()
+    engine.shutdown()
+
+    # fut.stream() consumes sequentially per future, so TTFT for later
+    # futures is read late — use the engine's own histogram for TTFT
+    ttft_p50 = stats['ttft_ms_p50']
+    ttft_p99 = stats['ttft_ms_p99']
+
+    return {
+        'requests': requests,
+        'slots': slots,
+        'max_new': max_new,
+        'decode_rr_tokens_per_sec': round(rr_tps, 1),
+        'decode_cb_tokens_per_sec': round(cb_tps, 1),
+        'cb_speedup': round(cb_tps / rr_tps, 2) if rr_tps else 0.0,
+        'rr_ttft_p50_ms': round(_pct(rr_ttft, 50), 1),
+        'rr_ttft_p99_ms': round(_pct(rr_ttft, 99), 1),
+        'ttft_p50_ms': round(ttft_p50, 1),
+        'ttft_p99_ms': round(ttft_p99, 1),
+        'traces_after_warmup': traces_after_warmup,
+        'traces': stats['traces'],
+        'compiles_ok': traces_after_warmup == 2
+        and stats['traces'] == traces_after_warmup,
+        'tokens_match': cb_tokens == rr_tokens,
+        'evictions': stats['evictions'],
+        'decode_steps': stats['steps'],
+        'ok': True,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--requests', type=int, default=8)
+    ap.add_argument('--slots', type=int, default=8)
+    ap.add_argument('--max-new', type=int, default=32)
+    ap.add_argument('--rate-ms', type=float, default=25.0,
+                    help='mean Poisson inter-arrival gap')
+    args = ap.parse_args(argv)
+    out = run_bench(requests=args.requests, slots=args.slots,
+                    max_new=args.max_new, rate_ms=args.rate_ms)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -18,7 +18,7 @@ import json
 import os
 import sys
 
-NAMESPACES = ('train', 'serve', 'fault', 'ckpt', 'data', 'warmup',
+NAMESPACES = ('train', 'serve', 'gen', 'fault', 'ckpt', 'data', 'warmup',
               'perf', 'slo')
 
 
