@@ -487,6 +487,60 @@ def _child_obs_overhead():
                       'obs_enabled': obs.enabled()}))
 
 
+def _child_dp2():
+    """2-device dp-mesh rung (always a CPU-mesh child — the parent forces
+    --xla_force_host_platform_device_count=2 so it runs on any host):
+    times the partitioner-resolved, donating, quantized-gradient train
+    step end to end. The parent joins tokens_per_sec with the 2-chip peak
+    into the mfu_dp2 column; collective_bytes_per_step is the analytic
+    int8 dp-gradient wire from distributed/quant_collectives with the f32
+    baseline alongside."""
+    _arm_watchdog(300)
+    import jax
+    _force_cpu_if_requested()
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import quant_collectives as qc
+    from paddle_tpu.distributed import topology as topo_mod
+    from paddle_tpu.models import gpt
+
+    dp = min(2, len(jax.devices()))
+    topo = topo_mod.set_topology(topo_mod.HybridTopology(dp=dp))
+    batch, seq, iters = 4, 64, 8
+    gcfg = gpt.GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, max_seq_len=seq, dtype='float32',
+                         use_flash=False, remat=False, grad_quant='int8')
+    params = gpt.init_params(gcfg, jax.random.PRNGKey(0))
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    opt_state = opt.functional_init(params)
+    step = gpt.make_train_step(gcfg, opt, topo.mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 256)
+    key = jax.random.PRNGKey(2)
+    lr = jnp.asarray(1e-3)
+    loss, params, opt_state = step(params, opt_state, key, lr, toks, toks)
+    float(loss)                                   # warm the compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt_state = step(params, opt_state, key, lr, toks,
+                                       toks)
+    final_loss = float(loss)
+    jax.block_until_ready(params)                 # fence the last update
+    dt = time.perf_counter() - t0
+    rep = qc.bytes_report(params, n_ranks=dp, modes=('f32', 'int8'))
+    print(json.dumps({
+        'tokens_per_sec': batch * seq * iters / dt,
+        'steps_per_sec': iters / dt,
+        'loss': final_loss,
+        'n_params': n_params,
+        'n_devices': dp,
+        'grad_quant': 'int8',
+        'collective_bytes_per_step': rep['bytes_int8'],
+        'collective_bytes_per_step_f32': rep['bytes_f32'],
+        'collective_reduction_vs_f32': rep['reduction_int8_vs_f32'],
+    }))
+
+
 def _child_smoke():
     """30s pallas compile-smoke: compile+run the flash fwd AND bwd kernels on
     a tiny shape with a host-read fence. Run by the tunnel watcher on relay
@@ -940,6 +994,31 @@ def main(fast=False):
                 # an OOM here IS the expected proof — record it honestly
                 out['vocab128k_naive_failed'] = vnote2[:300]
 
+    if not fast:
+        # 2-device dp rung: partitioner-resolved sharded step + quantized
+        # gradient wire. Always a CPU-mesh child so the columns exist on
+        # both CPU and TPU bench runs.
+        dp2_env = {'BENCH_FORCE_CPU': '1', 'JAX_PLATFORMS': 'cpu',
+                   'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+                   'BENCH_CHILD_TIMEOUT': '300'}
+        dp2, d2note = _run_child(['--child-dp2'], 300, env=dp2_env)
+        if dp2 is not None:
+            out['collective_bytes_per_step'] = round(
+                dp2['collective_bytes_per_step'], 1)
+            out['collective_bytes_per_step_f32'] = round(
+                dp2['collective_bytes_per_step_f32'], 1)
+            out['collective_reduction_vs_f32'] = \
+                dp2['collective_reduction_vs_f32']
+            ndev2 = max(1, dp2.get('n_devices', 2))
+            # per-chip MFU: global tokens/s against the ALL-chip peak
+            out['mfu_dp2'] = _mfu_pair(
+                dp2['tokens_per_sec'], dp2['n_params'],
+                {'layers': 2, 'seq': 64, 'hidden': 64},
+                _peak_flops('cpu')[0] * ndev2)[0]
+            out['dp2_tokens_per_sec'] = round(dp2['tokens_per_sec'], 1)
+        else:
+            print(f'dp2 rung failed: {d2note}', file=sys.stderr)
+
     print(json.dumps(out))
     return 0
 
@@ -965,6 +1044,8 @@ if __name__ == '__main__':
         _child_warmup()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':
         _child_obs_overhead()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':
+        _child_dp2()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':
         _child_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == '--smoke':

@@ -58,6 +58,8 @@ _fleet_state = {'initialized': False, 'strategy': None}
 
 def init(role_maker=None, is_collective=False, strategy=None):
     strategy = strategy or DistributedStrategy()
+    # fail fast on impossible degree products, before mesh construction
+    strategy.validate_degrees(jax.device_count())
     hc = strategy.hybrid_configs
     topo = HybridTopology(
         dp=int(hc.get('dp_degree', 1) or 1),
@@ -302,8 +304,10 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 def _zero_axes(topo):
-    return tuple(a for a in ('dp', 'sharding')
-                 if topo.axis_size(a) > 1) or ('dp',)
+    """Mesh axes backing ZeRO — resolved through the partitioner rules
+    table so fleet and the declarative path can never disagree."""
+    from ...parallel.partitioner import Partitioner
+    return Partitioner(mesh=topo.mesh).data_axes()
 
 
 def shard_opt_state(state, params):

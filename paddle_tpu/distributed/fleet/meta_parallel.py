@@ -51,11 +51,13 @@ class ColumnParallelLinear(Layer):
         self.weight = self.create_parameter(
             (in_features, out_features), weight_attr,
             default_initializer=I.XavierNormal())
-        self.weight.mesh_axes = PartitionSpec(None, 'mp')
+        # logical axes (parallel/partitioner.py): the column ('mlp') dim
+        # resolves to 'mp' through the rules table
+        self.weight.logical_axes = ('embed', 'mlp')
         self.bias = self.create_parameter((out_features,), None, is_bias=True) \
             if has_bias else None
         if self.bias is not None:
-            self.bias.mesh_axes = PartitionSpec('mp')
+            self.bias.logical_axes = ('mlp',)
 
     def forward(self, x):
         y = F.linear(x, self.weight, self.bias)
@@ -78,7 +80,7 @@ class RowParallelLinear(Layer):
         self.weight = self.create_parameter(
             (in_features, out_features), weight_attr,
             default_initializer=I.XavierNormal())
-        self.weight.mesh_axes = PartitionSpec('mp', None)
+        self.weight.logical_axes = ('mlp', 'embed')
         self.bias = self.create_parameter((out_features,), None, is_bias=True) \
             if has_bias else None
 
@@ -100,7 +102,7 @@ class VocabParallelEmbedding(Layer):
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), weight_attr,
             default_initializer=I.Normal(0.0, 0.02))
-        self.weight.mesh_axes = PartitionSpec('mp', None)
+        self.weight.logical_axes = ('vocab', 'embed')
 
     def forward(self, x):
         return F.embedding(x, self.weight)

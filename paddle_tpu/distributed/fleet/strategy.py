@@ -61,6 +61,43 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1
 
+    def validate_degrees(self, n_devices=None):
+        """Check the hybrid degrees fit the device count BEFORE any mesh is
+        built: the product of all requested degrees must divide n_devices
+        (leftover ways grow dp). A bad dp×mp product used to surface as an
+        opaque reshape error deep inside mesh construction."""
+        if n_devices is None:
+            import jax
+            n_devices = jax.device_count()
+        hc = self.hybrid_configs
+        # NB: no `or 1` — that would silently turn an (invalid) 0 into 1
+        deg = {k: (1 if hc.get(f'{k}_degree', 1) is None
+                   else int(hc.get(f'{k}_degree', 1)))
+               for k in ('dp', 'mp', 'pp', 'sharding', 'sp', 'ep')}
+        bad = {k: d for k, d in deg.items() if d < 1}
+        if bad:
+            raise ValueError(
+                f'DistributedStrategy.hybrid_configs degrees must be >= 1, '
+                f'got {bad}')
+        need = 1
+        for d in deg.values():
+            need *= d
+        if n_devices % need != 0:
+            raise ValueError(
+                f'DistributedStrategy.hybrid_configs degrees {deg} need '
+                f'dp*mp*pp*sharding*sp*ep = {need} ways, which does not '
+                f'divide the {n_devices} available device(s). Adjust the '
+                f'degrees (their product must divide the device count; '
+                f'leftover ways grow dp).')
+        return deg
+
+    def to_partition_rules(self, mesh=None):
+        """Compile this strategy down to the logical→mesh rules table
+        (parallel.partitioner.Partitioner) — the single source of truth
+        dp/mp/sharding placement resolves through."""
+        from ...parallel.partitioner import Partitioner
+        return Partitioner.from_strategy(self, mesh=mesh)
+
     def __setattr__(self, k, v):
         if v and k in ('dgc', 'fp16_allreduce'):
             warn_na_once(k, (

@@ -1,0 +1,201 @@
+"""Quantized gradient collectives: block-scaled int8/int4/fp8 psum over dp.
+
+Reference technique: EQuARX (arxiv 2506.17615) — an all-reduce that moves a
+narrow block-quantized payload plus per-block scales instead of full-width
+gradients, with stochastic rounding so the compression noise is unbiased
+and training converges like the full-precision baseline.
+
+Scheme per leaf (shared-scale variant, exact-summable):
+
+  1. flatten + pad to a multiple of ``block``; per-block local amax,
+  2. ``pmax`` the amaxes over the reduction axis → one shared scale per
+     block (a tiny f32 collective: size/block elements),
+  3. stochastic-round ``x/scale`` to the narrow grid (int8: ±127,
+     int4: ±7, fp8: e4m3 cast) — unbiased: E[q] = x/scale,
+  4. ``psum`` the narrow payload (accumulated wide — a native ring
+     implementation requantizes per hop; XLA has no such primitive, so
+     the *semantics* here are exact-sum-of-quantized-values and the wire
+     cost is what ``collective_bytes`` accounts),
+  5. multiply back by the shared scale (and 1/N for a mean).
+
+Because every rank quantizes onto the SAME per-block grid, the integer sum
+is exact — the only error is each rank's rounding, bounded by
+``n_ranks * scale`` per element (tested). ``mode='bf16'`` is the fallback
+knob: a plain cast-to-bf16 psum, no scales, no rounding noise beyond bf16.
+
+Byte accounting is analytic (ring all-reduce, 2(n-1)/n traversals): the
+tool/bench columns compare f32/bf16 wire bytes against payload+scales —
+int8 cuts the dp gradient axis ~3.9x vs f32, int4 ~3.9x vs bf16.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+# leaves smaller than this ride the exact full-width psum: biases and norm
+# gains are a rounding error of the wire bytes but outsized for stability
+DEFAULT_MIN_SIZE = 2048
+DEFAULT_BLOCK = 256
+
+_MODES = ('none', 'bf16', 'int8', 'int4', 'fp8')
+
+# narrow-grid parameters: (quantized max magnitude, payload bytes/element)
+_QMAX = {'int8': 127.0, 'int4': 7.0, 'fp8': 448.0}
+_PAYLOAD_BYTES = {'int8': 1.0, 'int4': 0.5, 'fp8': 1.0}
+_SCALE_BYTES = 2.0          # per-block scale travels as bf16
+
+
+def _check_mode(mode):
+    if mode not in _MODES:
+        raise ValueError(f'quantized-collective mode must be one of '
+                         f'{_MODES}, got {mode!r}')
+    if mode == 'fp8' and not hasattr(jnp, 'float8_e4m3fn'):
+        raise ValueError('fp8 quantized collectives need a jax with '
+                         'float8_e4m3fn; use int8 or bf16')
+    return mode
+
+
+def _blocked(x, block):
+    """flatten + zero-pad to [n_blocks, block]."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(nb, block), n
+
+
+def quantized_psum(x, axis_name, *, mode='int8', block=DEFAULT_BLOCK,
+                   seed=None, stochastic=True, mean=False):
+    """psum/pmean of ``x`` over ``axis_name`` through the quantized wire.
+
+    seed: traced uint32 driving stochastic rounding (required when
+    ``stochastic`` and mode is int8/int4); ranks are decorrelated by
+    folding in their axis index. Must be called inside shard_map over
+    ``axis_name``.
+    """
+    _check_mode(mode)
+    if mode in ('int8', 'int4') and stochastic and seed is None:
+        raise ValueError('stochastic rounding needs a seed (pass seed=, '
+                         'or stochastic=False)')
+    n = jax.lax.psum(1, axis_name)
+    orig_dtype = x.dtype
+    denom = jnp.asarray(n, jnp.float32) if mean else None
+    if mode == 'none':
+        out = jax.lax.psum(x, axis_name)
+        return (out / denom.astype(orig_dtype)) if mean else out
+    if mode == 'bf16':
+        out = jax.lax.psum(x.astype(jnp.bfloat16), axis_name)
+        out = out.astype(jnp.float32)
+        if mean:
+            out = out / denom
+        return out.astype(orig_dtype)
+
+    xb, size = _blocked(x.astype(jnp.float32), block)
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    # shared per-block scale: every rank quantizes onto the same grid, so
+    # the integer sum across ranks is exact (scale wire: nb f32 elements)
+    amax = jax.lax.pmax(amax, axis_name)
+    qmax = _QMAX[mode]
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    y = xb / scale
+
+    if mode == 'fp8':
+        q = y.astype(jnp.float8_e4m3fn).astype(jnp.float32)
+        total = jax.lax.psum(q, axis_name)
+    else:
+        if stochastic:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(jnp.asarray(seed, jnp.uint32)),
+                jax.lax.axis_index(axis_name))
+            u = jax.random.uniform(key, y.shape)
+            q = jnp.floor(y + u)
+        else:
+            q = jnp.round(y)
+        q = jnp.clip(q, -qmax, qmax)
+        total = jax.lax.psum(q.astype(jnp.int32), axis_name)
+
+    out = total.astype(jnp.float32) * scale
+    if mean:
+        out = out / denom
+    return out.reshape(-1)[:size].reshape(x.shape).astype(orig_dtype)
+
+
+def psum_tree(tree, axis_name, *, mode='int8', block=DEFAULT_BLOCK,
+              seed=None, stochastic=True, mean=True,
+              min_size=DEFAULT_MIN_SIZE):
+    """Quantized psum/pmean over a gradient pytree. Leaves smaller than
+    ``min_size`` (biases, norm params) use the exact full-width reduction;
+    each quantized leaf folds its index into the seed so rounding noise is
+    decorrelated across leaves."""
+    _check_mode(mode)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for i, g in enumerate(leaves):
+        leaf_mode = mode if (mode in ('bf16',) or g.size >= min_size) \
+            else 'none'
+        leaf_seed = None
+        if seed is not None:
+            leaf_seed = jnp.asarray(seed, jnp.uint32) ^ jnp.uint32(
+                (i * 0x9E3779B9) & 0xFFFFFFFF)
+        out.append(quantized_psum(g, axis_name, mode=leaf_mode, block=block,
+                                  seed=leaf_seed, stochastic=stochastic,
+                                  mean=mean))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# analytic wire-byte accounting (ring all-reduce)
+# ---------------------------------------------------------------------------
+
+def _ring_factor(n_ranks):
+    # reduce-scatter + all-gather: each element crosses the wire
+    # 2(n-1)/n times per rank
+    return 2.0 * (n_ranks - 1) / n_ranks if n_ranks > 1 else 0.0
+
+
+def leaf_bytes(size, itemsize, mode, n_ranks, block=DEFAULT_BLOCK,
+               min_size=DEFAULT_MIN_SIZE):
+    """Wire bytes one leaf contributes to a ring all-reduce over
+    ``n_ranks`` in ``mode`` ('f32'/'bf16' = plain cast; int8/int4/fp8 =
+    payload + per-block bf16 scales + the f32 amax pmax exchange)."""
+    rf = _ring_factor(n_ranks)
+    if mode in ('none', 'f32'):
+        return rf * size * itemsize
+    if mode == 'bf16':
+        return rf * size * 2.0
+    if size < min_size:
+        return rf * size * itemsize      # small leaves stay full width
+    nb = math.ceil(size / block)
+    payload = rf * size * _PAYLOAD_BYTES[mode]
+    scales = rf * nb * _SCALE_BYTES
+    amax_exchange = rf * nb * 4.0        # f32 pmax establishing the grid
+    return payload + scales + amax_exchange
+
+
+def collective_bytes(tree, n_ranks, mode='int8', block=DEFAULT_BLOCK,
+                     min_size=DEFAULT_MIN_SIZE):
+    """Total analytic wire bytes for one gradient all-reduce of ``tree``."""
+    total = 0.0
+    for g in jax.tree_util.tree_leaves(tree):
+        itemsize = jnp.dtype(getattr(g, 'dtype', jnp.float32)).itemsize
+        total += leaf_bytes(g.size, itemsize, mode, n_ranks, block, min_size)
+    return total
+
+
+def bytes_report(tree, n_ranks, modes=('f32', 'bf16', 'int8', 'int4'),
+                 block=DEFAULT_BLOCK, min_size=DEFAULT_MIN_SIZE):
+    """{mode: wire_bytes} + reduction ratios vs f32 and bf16 — the dict
+    behind tools/shard_check.py and the bench column."""
+    out = {m: collective_bytes(tree, n_ranks, m, block, min_size)
+           for m in modes}
+    rep = {f'bytes_{m}': v for m, v in out.items()}
+    for m in modes:
+        if m in ('f32', 'bf16'):
+            continue
+        if out.get('f32'):
+            rep[f'reduction_{m}_vs_f32'] = round(out['f32'] / out[m], 3)
+        if out.get('bf16'):
+            rep[f'reduction_{m}_vs_bf16'] = round(out['bf16'] / out[m], 3)
+    return rep

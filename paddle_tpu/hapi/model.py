@@ -90,6 +90,8 @@ class Model:
         self._step_timer = None
         self._engine = None
         self._engine_kwargs = None
+        self._strategy = None
+        self._partitioner = None
         self._async = os.environ.get('PADDLE_TPU_SYNC_EXECUTOR') != '1'
         try:
             self._inflight_window = max(
@@ -100,7 +102,15 @@ class Model:
 
     # ---- setup -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, warmup=None):
+                amp_configs=None, warmup=None, strategy=None):
+        """strategy (fleet.DistributedStrategy, optional): compiles down to
+        a partitioner rules table (parallel/partitioner.py) — the train
+        state is placed over the strategy's mesh (params per their
+        logical_axes annotations, batches sharded over the 'batch' rule,
+        optimizer state ZeRO-sharded when strategy.sharding) and the
+        already-donating async-executor jit then runs the whole state as
+        one SPMD program with device residency and buffer reuse. Set it
+        before the first train_batch."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -109,6 +119,9 @@ class Model:
         self._train_steps = {}
         self._eval_steps = {}
         self._opt_init_pending = True
+        if strategy is not None:
+            self._strategy = strategy
+            self._partitioner = strategy.to_partition_rules()
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -181,6 +194,26 @@ class Model:
             ts = _TrainState()
             ts.params = {n: self._real_value(p) for n, p in named_p}
             ts.buffers = {n: self._real_value(b) for n, b in named_b}
+            if self._partitioner is not None:
+                # place the captured state over the strategy mesh: params
+                # per their resolved specs, buffers replicated — the jit'd
+                # step propagates these in-shardings (GSPMD) and donation
+                # keeps the outputs aliased in place
+                from jax.sharding import NamedSharding, PartitionSpec
+                from ..parallel.parallelize import param_spec
+                mesh = self._partitioner.mesh
+
+                def _put(v, spec):
+                    try:
+                        return jax.device_put(v, NamedSharding(mesh, spec))
+                    except Exception:
+                        return v
+                ts.params = {
+                    n: _put(ts.params[n],
+                            param_spec(p, n, self._partitioner))
+                    for n, p in named_p}
+                ts.buffers = {n: _put(v, PartitionSpec())
+                              for n, v in ts.buffers.items()}
             ts.opt_state = prev_opt
             ts.mut_version = _core_tensor.mutation_version()
             ts.refs_dirty = True
@@ -426,6 +459,14 @@ class Model:
             return t
         return jnp.asarray(t)
 
+    def _maybe_place_batch(self, arr):
+        """Shard a batch array's leading dim per the partitioner's 'batch'
+        rule (no-op without a strategy, or for scalars)."""
+        pt = self._partitioner
+        if pt is None or getattr(arr, 'ndim', 0) == 0:
+            return arr
+        return pt.place_batch(arr)
+
     # ---- public batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         from ..distributed.launch import touch_heartbeat
@@ -450,9 +491,15 @@ class Model:
             # a restored opt_state (Model.load / AutoResume) must survive
             # the lazy first-step build instead of being re-initialized
             ts.opt_state = self._optimizer.functional_init(ts.params)
+            if (self._partitioner is not None and self._strategy is not None
+                    and getattr(self._strategy, 'sharding', False)):
+                # ZeRO-1: optimizer states sharded over the data axes
+                ts.opt_state = self._partitioner.place_zero(ts.opt_state)
         self._opt_init_pending = False
-        inputs = [self._as_device(t) for t in _to_list(inputs)]
-        labels = [self._as_device(t) for t in _to_list(labels)]
+        inputs = [self._maybe_place_batch(self._as_device(t))
+                  for t in _to_list(inputs)]
+        labels = [self._maybe_place_batch(self._as_device(t))
+                  for t in _to_list(labels)]
         wm = sys.modules.get('paddle_tpu.warmup.manifest')
         if wm is not None and wm.capturing():
             wm.record(wm.train_step_entry(
@@ -514,8 +561,10 @@ class Model:
     def eval_batch(self, inputs, labels=None):
         self._enter_mode(False)
         _obs.counter('train.eval_batches').inc()
-        inputs = [self._as_device(t) for t in _to_list(inputs)]
-        labels = [self._as_device(t) for t in _to_list(labels)]
+        inputs = [self._maybe_place_batch(self._as_device(t))
+                  for t in _to_list(inputs)]
+        labels = [self._maybe_place_batch(self._as_device(t))
+                  for t in _to_list(labels)]
         # cache keyed on (mode, input signature) like the train path keys on
         # mode: a predict stream with a ragged tail batch (or alternating
         # labeled/unlabeled calls) selects its cached step by shape/dtype

@@ -93,9 +93,20 @@ class GPTConfig:
     # size. Numerics are unchanged (tested); throughput is a chip-side
     # tuning knob (tools/tpu_tune.py --round3 rung).
     scan_unroll: int = 1
+    # quantized dp-gradient all-reduce (distributed/quant_collectives,
+    # EQuARX-style): 'none' keeps the full-width reduction; 'bf16' is the
+    # cast fallback knob; 'int8'/'int4' move a block-scaled payload with
+    # stochastic rounding; 'fp8' when the jax build has float8. Any value
+    # but 'none' routes the train step through the explicit-collective
+    # (shard_map) path so the reduction is addressable.
+    grad_quant: str = 'none'
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
+        if self.grad_quant not in ('none', 'bf16', 'int8', 'int4', 'fp8'):
+            raise ValueError(
+                f"grad_quant must be one of 'none'/'bf16'/'int8'/'int4'/"
+                f"'fp8', got {self.grad_quant!r}")
 
     @property
     def head_dim(self):
@@ -147,20 +158,38 @@ def init_params(config: GPTConfig, key):
     }
 
 
+# Logical axis names per parameter (parallel/partitioner.py): the Megatron
+# column/row/pipeline layout is no longer written here as PartitionSpec
+# literals — it falls out of one rules table ('heads'/'mlp' -> 'mp',
+# 'layers' -> 'pp', 'vocab' -> 'mp' on the GSPMD path). 'positions' is
+# deliberately unmapped: every sp rank slices its own rows from a full wpe.
+LOGICAL_AXES = {
+    'wte': ('vocab', 'embed'),
+    'wpe': ('positions', 'embed'),
+    'blocks': {
+        'ln1_g': ('layers', 'embed'), 'ln1_b': ('layers', 'embed'),
+        'qkv_w': ('layers', 'embed', 'heads'),
+        'qkv_b': ('layers', 'heads'),
+        'proj_w': ('layers', 'heads', 'embed'),
+        'proj_b': ('layers', 'embed'),
+        'ln2_g': ('layers', 'embed'), 'ln2_b': ('layers', 'embed'),
+        'fc_w': ('layers', 'embed', 'mlp'), 'fc_b': ('layers', 'mlp'),
+        'out_w': ('layers', 'mlp', 'embed'), 'out_b': ('layers', 'embed'),
+    },
+    'lnf_g': ('embed',), 'lnf_b': ('embed',),
+}
+
+
+def _partitioner(config: GPTConfig, explicit):
+    from ..parallel.partitioner import Partitioner, model_rules
+    return Partitioner(rules=model_rules(
+        mp=config.mp, pp=config.pp, sp=config.sp, explicit=explicit))
+
+
 def param_specs(config: GPTConfig):
-    """Megatron-style PartitionSpecs: QKV/fc column-sharded, proj/out
-    row-sharded over 'mp'; blocks' leading layer dim sharded over 'pp'."""
-    pp = 'pp' if config.pp > 1 else None
-    blocks = {
-        'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
-        'qkv_w': P(pp, None, 'mp'), 'qkv_b': P(pp, 'mp'),
-        'proj_w': P(pp, 'mp', None), 'proj_b': P(pp, None),
-        'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
-        'fc_w': P(pp, None, 'mp'), 'fc_b': P(pp, 'mp'),
-        'out_w': P(pp, 'mp', None), 'out_b': P(pp, None),
-    }
-    return {'wte': P('mp', None), 'wpe': P(None, None), 'blocks': blocks,
-            'lnf_g': P(None), 'lnf_b': P(None)}
+    """PartitionSpecs for the GSPMD (jit + propagation) path, resolved from
+    LOGICAL_AXES through the partitioner rules table."""
+    return _partitioner(config, explicit=False).tree_specs(LOGICAL_AXES)
 
 
 def _remat(body, config):
@@ -705,17 +734,28 @@ def make_decode_fns(config: GPTConfig):
 # Hybrid-parallel train step
 # ---------------------------------------------------------------------------
 
+def _uses_shard_map(config: GPTConfig):
+    """Explicit-collective path: sp ring / pp pipeline schedules, or a
+    quantized gradient all-reduce (which needs an addressable dp psum)."""
+    return (config.sp > 1 or config.pp > 1
+            or getattr(config, 'grad_quant', 'none') not in (None, 'none'))
+
+
 def make_train_step(config: GPTConfig, optimizer, mesh=None):
     """Returns jitted step(params, opt_state, key, lr, tokens, targets) ->
     (loss, params, opt_state) sharded over the mesh. Shardings:
       params per param_specs (mp/pp), batch over ('dp',), sequence over 'sp'
       (ring attention), opt state ZeRO-sharded over dp when configured.
+    config.grad_quant != 'none' reduces dp gradients through
+    distributed/quant_collectives (block-scaled int8/int4/fp8 or the bf16
+    fallback) instead of the full-width pmean.
     """
     from ..distributed.topology import get_mesh
     mesh = mesh or get_mesh()
     specs = param_specs(config)
+    quant = getattr(config, 'grad_quant', 'none') or 'none'
 
-    use_shard_map = config.sp > 1 or config.pp > 1
+    use_shard_map = _uses_shard_map(config)
     if config.dropout > 0.0 and config.pp > 1:
         # the pipeline loss paths do not sample dropout; silently training
         # a different model than configured is the r4-journey bug class —
@@ -744,6 +784,10 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
     explicit_mp = config.mp > 1
 
     if config.pp > 1 and config.pp_schedule == '1f1b':
+        if quant != 'none':
+            raise NotImplementedError(
+                'grad_quant under the fused 1F1B schedule is not '
+                "implemented — use pp_schedule='gpipe' or grad_quant='none'")
         return _make_train_step_1f1b(config, optimizer, mesh, explicit_mp)
 
     def spmd_loss(params, tokens, targets, seed=None):
@@ -812,9 +856,11 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
         """value+grad INSIDE shard_map: the only collectives the vjp sees are
         ppermute (pipeline/ring — exact inverse-permutation transpose) and the
         custom-vjp Megatron f/g pair, so grads are exact per rank. Cross-rank
-        reductions are applied explicitly afterwards."""
+        reductions are applied explicitly afterwards — which is what makes
+        the dp gradient reduction addressable for quant_collectives."""
+        drop_seed = seed if config.dropout > 0.0 else None
         loss, grads = jax.value_and_grad(
-            lambda p: spmd_loss(p, tokens, targets, seed))(params)
+            lambda p: spmd_loss(p, tokens, targets, drop_seed))(params)
         if config.pp > 1:
             # shared (non-block) params: embedding grads live on stage 0,
             # head grads on the last stage → assemble across stages
@@ -826,14 +872,32 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
         reduce_axes = ['dp'] + (['sp'] if config.sp > 1 else [])
         for ax in reduce_axes:
             loss = jax.lax.pmean(loss, ax)
-            grads = jax.tree_util.tree_map(
-                lambda g, _ax=ax: jax.lax.pmean(g, _ax), grads)
+            if ax == 'dp' and quant != 'none':
+                from ..distributed import quant_collectives as qc
+                from ..ops.flash_attention import mix_seed
+                qseed = None
+                if seed is not None:
+                    # decorrelate the rounding stream from the dropout
+                    # stream sharing the same step seed
+                    qseed = mix_seed(jnp.asarray(seed, jnp.uint32)
+                                     ^ jnp.uint32(0xA5A5F00D))
+                grads = qc.psum_tree(grads, 'dp', mode=quant,
+                                     seed=qseed,
+                                     stochastic=qseed is not None,
+                                     mean=True)
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g, _ax=ax: jax.lax.pmean(g, _ax), grads)
         return loss, grads
 
     pspec_tree = train_specs(config)
-    data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
+    data_spec = _partitioner(config, explicit=True).spec(('batch', 'length'))
 
-    if config.dropout > 0.0:
+    # a seed rides the step key into shard_map when anything inside needs
+    # per-step randomness: attention dropout, or stochastic rounding in the
+    # quantized gradient all-reduce
+    needs_seed = config.dropout > 0.0 or quant in ('int8', 'int4')
+    if needs_seed:
         smapped = shard_map(spmd_valgrad, mesh=mesh,
                             in_specs=(pspec_tree, data_spec, data_spec,
                                       P()),
@@ -912,7 +976,7 @@ def _make_train_step_1f1b(config: GPTConfig, optimizer, mesh, explicit_mp):
         return loss, grads
 
     pspec_tree = train_specs(config)
-    data_spec = P('dp', 'sp') if config.sp > 1 else P('dp', None)
+    data_spec = _partitioner(config, explicit=True).spec(('batch', 'length'))
     smapped = shard_map(spmd_grads, mesh=mesh,
                         in_specs=(pspec_tree, data_spec, data_spec),
                         out_specs=(P(), pspec_tree), check_rep=False)
@@ -926,21 +990,12 @@ def _make_train_step_1f1b(config: GPTConfig, optimizer, mesh, explicit_mp):
 
 
 def train_specs(config: GPTConfig):
-    """PartitionSpecs matching what make_train_step expects for params."""
-    if config.sp > 1 or config.pp > 1:
-        pp = 'pp' if config.pp > 1 else None
-        mp = 'mp' if config.mp > 1 else None
-        blocks = {
-            'ln1_g': P(pp, None), 'ln1_b': P(pp, None),
-            'qkv_w': P(pp, None, mp), 'qkv_b': P(pp, mp),
-            'proj_w': P(pp, mp, None), 'proj_b': P(pp, None),
-            'ln2_g': P(pp, None), 'ln2_b': P(pp, None),
-            'fc_w': P(pp, None, mp), 'fc_b': P(pp, mp),
-            'out_w': P(pp, mp, None), 'out_b': P(pp, None),
-        }
-        return {'wte': P(None, None), 'wpe': P(None, None), 'blocks': blocks,
-                'lnf_g': P(None), 'lnf_b': P(None)}
-    return param_specs(config)
+    """PartitionSpecs matching what make_train_step expects for params:
+    the explicit-collective (shard_map) rules when the step uses that path
+    — per-rank views, vocab replicated — otherwise the GSPMD rules. Both
+    resolve LOGICAL_AXES through the same partitioner rules table."""
+    explicit = _uses_shard_map(config)
+    return _partitioner(config, explicit=explicit).tree_specs(LOGICAL_AXES)
 
 
 def place_params(params, config, mesh):

@@ -96,18 +96,35 @@ def init_params(config: MoEConfig, key):
             'lnf_b': jnp.zeros((h,), pdt)}
 
 
+# Logical axis names per parameter (parallel/partitioner.py): experts ride
+# 'expert' -> 'ep', attention/FFN widths 'heads'/'mlp' -> 'mp', the tied
+# embedding 'vocab' -> 'mp' — all from the same rules table gpt.py uses.
+# 'router' (gate_w's expert dim) is deliberately unmapped: the gate is tiny
+# and every rank routes locally.
+LOGICAL_AXES = {
+    'wte': ('vocab', 'embed'),
+    'wpe': ('positions', 'embed'),
+    'blocks': {
+        'ln1_g': ('layers', 'embed'), 'ln1_b': ('layers', 'embed'),
+        'qkv_w': ('layers', 'embed', 'heads'),
+        'qkv_b': ('layers', 'heads'),
+        'proj_w': ('layers', 'heads', 'embed'),
+        'proj_b': ('layers', 'embed'),
+        'ln2_g': ('layers', 'embed'), 'ln2_b': ('layers', 'embed'),
+        'gate_w': ('layers', 'embed', 'router'),
+        'w_in': ('layers', 'expert', 'embed', 'mlp'),
+        'w_out': ('layers', 'expert', 'mlp', 'embed'),
+    },
+    'lnf_g': ('embed',), 'lnf_b': ('embed',),
+}
+
+
 def param_specs(config: MoEConfig):
-    """Experts sharded over 'ep'; dense weights replicated (mp optional)."""
-    blocks = {
-        'ln1_g': P(), 'ln1_b': P(),
-        'qkv_w': P(None, None, 'mp'), 'qkv_b': P(None, 'mp'),
-        'proj_w': P(None, 'mp', None), 'proj_b': P(),
-        'ln2_g': P(), 'ln2_b': P(),
-        'gate_w': P(), 'w_in': P(None, 'ep', None, 'mp'),
-        'w_out': P(None, 'ep', 'mp', None),
-    }
-    return {'wte': P('mp', None), 'wpe': P(), 'blocks': blocks,
-            'lnf_g': P(), 'lnf_b': P()}
+    """Experts sharded over 'ep'; dense weights replicated (mp optional) —
+    resolved from LOGICAL_AXES through the partitioner rules table."""
+    from ..parallel.partitioner import Partitioner, model_rules
+    return Partitioner(rules=model_rules(mp=config.mp)).tree_specs(
+        LOGICAL_AXES)
 
 
 def block_fn(bp, carry, config, drop_seed=None):

@@ -23,6 +23,16 @@ Peaks come from a per-device-kind table; ``PADDLE_TPU_PEAK_FLOPS`` /
 ``PADDLE_TPU_PEAK_BW`` override both numbers for unlisted hardware (read
 per call so tests and long-lived processes can re-point them).
 
+Multi-device executables are accounted PER CHIP: the peak table is
+per-chip, so the cost-model FLOPs joined against it must be too. Whether
+``cost_analysis()`` reports per-partition or whole-module numbers for an
+SPMD executable varies by XLA version, so a one-shot calibration probe
+(``_cost_convention``: a 2-device-sharded matmul vs the same matmul on one
+device) decides the convention once per process; under 'total' the
+figures are divided by the executable's addressable device count. Records
+carry ``n_devices`` and ``perf.devices{fn}`` either way, and
+``perf.mfu{fn}`` is per-chip — invariant to mesh width.
+
 Disabled mode (``PADDLE_TPU_OBS=0``): every entry point is a no-op
 returning ``None`` — no compile-cache touches, no registry families.
 """
@@ -115,8 +125,53 @@ def _extract(compiled):
     return flops, nbytes, mem
 
 
+def _n_devices(compiled):
+    """Addressable device count of one executable (1 on any failure)."""
+    try:
+        return max(1, len(compiled.runtime_executable().local_devices()))
+    except Exception:
+        return 1
+
+
+_convention = None
+
+
+def _cost_convention():
+    """Does cost_analysis() report per-partition or whole-module numbers
+    for SPMD executables? Calibrated once per process: compile the same
+    matmul sharded over 2 devices and unsharded, compare FLOPs. Falls back
+    to 'per_partition' (measured on the pinned jax) when <2 devices or the
+    probe fails."""
+    global _convention
+    if _convention is not None:
+        return _convention
+    try:
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devs = jax.devices()
+        if len(devs) < 2:
+            _convention = 'per_partition'
+            return _convention
+        x = jnp.ones((256, 256), jnp.float32)
+        f = jax.jit(lambda a: a @ a)
+        flops1 = _extract(f.lower(x).compile())[0]
+        mesh = Mesh(np.asarray(devs[:2]).reshape(2), ('_probe',))
+        xs = jax.device_put(x, NamedSharding(
+            mesh, PartitionSpec('_probe', None)))
+        flops2 = _extract(f.lower(xs).compile())[0]
+        _convention = ('per_partition' if 0 < flops2 <= 0.75 * flops1
+                       else 'total')
+    except Exception:
+        _convention = 'per_partition'
+    return _convention
+
+
 def analyze_compiled(label, compiled):
     """Publish one compiled executable's static costs under ``fn=label``.
+    All figures are PER CHIP (see module docstring) so the roofline/MFU
+    join against the per-chip peak table stays honest under a mesh.
     Returns the roofline record (also stored for ``note_step``/``report``)
     or ``None`` when disabled / the runtime exposes no cost model."""
     if not cfg.enabled:
@@ -126,6 +181,10 @@ def analyze_compiled(label, compiled):
     except Exception:
         _registry().counter('perf.analyze_errors', {'fn': label}).inc()
         return None
+    n_dev = _n_devices(compiled)
+    if n_dev > 1 and _cost_convention() == 'total':
+        flops, nbytes = flops / n_dev, nbytes / n_dev
+        mem = {k: v // n_dev for k, v in mem.items()}
     peak_f, peak_bw, _ = peaks()
     ridge = peak_f / peak_bw
     intensity = flops / nbytes if nbytes else 0.0
@@ -133,6 +192,7 @@ def analyze_compiled(label, compiled):
     lbl = {'fn': label}
     reg = _registry()
     reg.gauge('perf.flops', lbl).set(flops)
+    reg.gauge('perf.devices', lbl).set(n_dev)
     reg.gauge('perf.bytes_accessed', lbl).set(nbytes)
     reg.gauge('perf.arithmetic_intensity', lbl).set(round(intensity, 4))
     reg.gauge('perf.compute_bound', lbl).set(
@@ -143,8 +203,9 @@ def analyze_compiled(label, compiled):
     reg.gauge('perf.peak_bw').set(peak_bw)
     reg.gauge('perf.ridge').set(round(ridge, 4))
     rec = {'fn': label, 'flops': flops, 'bytes_accessed': nbytes,
-           'intensity': round(intensity, 4), 'bound_by': bound_by,
-           'hbm': mem, 'mfu': None, 'step_ms_p50': None}
+           'n_devices': n_dev, 'intensity': round(intensity, 4),
+           'bound_by': bound_by, 'hbm': mem, 'mfu': None,
+           'step_ms_p50': None}
     with _lock:
         _records[label] = rec
         _mfu_handles.pop(label, None)
@@ -178,9 +239,10 @@ def analyzed(label):
 
 
 def note_step(label, seconds):
-    """Join a measured wall-time with ``label``'s static FLOPs: observes
-    ``perf.step_ms{fn}`` and sets ``perf.mfu{fn}`` + the headline
-    ``perf.mfu`` gauge. No-op (still timing-safe) before ``analyze``."""
+    """Join a measured wall-time with ``label``'s static per-chip FLOPs:
+    observes ``perf.step_ms{fn}`` and sets ``perf.mfu{fn}`` (per-chip —
+    mesh-width invariant) + the headline ``perf.mfu`` gauge. No-op (still
+    timing-safe) before ``analyze``."""
     if not cfg.enabled or seconds <= 0:
         return None
     with _lock:

@@ -7,3 +7,5 @@ from .moe import moe_ffn, top2_gating  # noqa: F401
 from .parallelize import make_sharded_train_step, shard_params  # noqa: F401
 from . import zero  # noqa: F401
 from .zero import make_zero_train_step  # noqa: F401
+from .partitioner import (Partitioner, ShardingRuleError,  # noqa: F401
+                          DEFAULT_RULES, model_rules)

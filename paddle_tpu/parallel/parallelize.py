@@ -1,8 +1,10 @@
 """Sharded train-step builder: the glue between the Layer API and pjit.
 
-Takes a paddle_tpu Layer (whose parallel layers carry ``mesh_axes``
-PartitionSpecs), a loss and an optimizer, and returns ONE jitted SPMD program
-over the mesh doing forward+backward+update with:
+Takes a paddle_tpu Layer (whose parallel layers carry ``logical_axes``
+names resolved through the partitioner rules table — ``mesh_axes``
+PartitionSpecs remain an accepted escape hatch), a loss and an optimizer,
+and returns ONE jitted SPMD program over the mesh doing
+forward+backward+update with:
   - params/opt-state placed per their specs (mp/ep sharded, rest replicated
     or ZeRO-sharded over dp)
   - batch sharded over ('dp', 'sp')
@@ -18,18 +20,25 @@ from ..tensor.random import rng_scope
 from ..distributed.topology import get_mesh
 
 
-def param_spec(p, name=''):
+def param_spec(p, name='', partitioner=None):
+    """Resolve one Parameter's placement: ``logical_axes`` names through
+    the rules table (default table if no partitioner given), else a raw
+    ``mesh_axes`` PartitionSpec, else replicated."""
+    la = getattr(p, 'logical_axes', None)
+    if la is not None:
+        from .partitioner import Partitioner
+        return (partitioner or Partitioner()).spec(la)
     spec = getattr(p, 'mesh_axes', None)
     return spec if spec is not None else PartitionSpec()
 
 
-def shard_params(layer, mesh=None):
-    """device_put every Parameter per its PartitionSpec annotation."""
+def shard_params(layer, mesh=None, partitioner=None):
+    """device_put every Parameter per its resolved annotation."""
     mesh = mesh or get_mesh()
     for n, p in layer.named_parameters():
         try:
             p._replace_value(jax.device_put(
-                p._value, NamedSharding(mesh, param_spec(p, n))))
+                p._value, NamedSharding(mesh, param_spec(p, n, partitioner))))
         except Exception:
             pass
     return layer
@@ -37,7 +46,7 @@ def shard_params(layer, mesh=None):
 
 def make_sharded_train_step(layer, loss_fn, optimizer, mesh=None,
                             batch_axes=('dp',), label_axes=None,
-                            donate=True):
+                            donate=True, partitioner=None):
     """Returns (step, init_state) where
     step(params, buffers, opt_state, key, lr, inputs, labels)
       -> (loss, params, buffers, opt_state)
@@ -46,7 +55,8 @@ def make_sharded_train_step(layer, loss_fn, optimizer, mesh=None,
     """
     mesh = mesh or get_mesh()
     pnames = [n for n, _ in layer.named_parameters()]
-    pspecs = {n: param_spec(p, n) for n, p in layer.named_parameters()}
+    pspecs = {n: param_spec(p, n, partitioner)
+              for n, p in layer.named_parameters()}
     bspecs = {n: PartitionSpec() for n, _ in layer.named_buffers()}
 
     def set_mode(training):
@@ -77,7 +87,7 @@ def make_sharded_train_step(layer, loss_fn, optimizer, mesh=None,
     def init_state():
         params = {n: p._value for n, p in layer.named_parameters()}
         buffers = {n: b._value for n, b in layer.named_buffers()}
-        shard_params(layer, mesh)
+        shard_params(layer, mesh, partitioner)
         params = {n: p._value for n, p in layer.named_parameters()}
         opt_state = optimizer.functional_init(params)
         return params, buffers, opt_state

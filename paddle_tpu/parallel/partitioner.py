@@ -1,0 +1,266 @@
+"""Declarative logical-axis partitioner: one rules table for every layout.
+
+Reference technique: t5x partitioning (SNIPPETS [3]) — parameters and
+activations are annotated with *logical* axis names (``('embed', 'mlp')``,
+``('batch', 'length', 'heads')``, …) and a single ordered rules table maps
+each logical name onto a mesh axis (or None = replicated). Every placement
+decision in the stack — Megatron mp column/row sharding, pipeline stacking,
+expert sharding, ZeRO over dp, batch sharding — resolves through this one
+table instead of hand-written ``PartitionSpec`` literals scattered across
+``models/gpt.py``, ``models/moe_gpt.py``, ``parallel/zero.py`` and
+``parallel/parallelize.py``.
+
+Resolution semantics (t5x-compatible):
+
+  - rules are scanned IN ORDER; the first rule whose logical name matches
+    wins (rule precedence),
+  - a mesh axis may appear at most once per spec — when a matching rule's
+    mesh axis is already taken by an earlier dim of the same tensor, the
+    scan continues to later rules for that name (falling back to
+    replicated if none fit),
+  - a logical name with no rule resolves to None (replicated) — safety
+    first: forgetting a rule can cost memory, never correctness,
+  - with a mesh attached, rules must name real mesh axes, and an explicit
+    ``shape`` makes non-divisible dims raise ``ShardingRuleError`` instead
+    of relying on GSPMD padding.
+
+``Partitioner.from_strategy`` compiles a fleet ``DistributedStrategy``
+(dp/mp/pp/sharding degrees) down to a rules table + mesh, validating that
+the requested degrees actually fit the device count before any mesh
+construction starts.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class ShardingRuleError(ValueError):
+    """A rules-table entry cannot be applied: unknown mesh axis, or a
+    tensor dim that does not divide the mesh-axis degree."""
+
+
+# Logical axis vocabulary used by the in-tree models. A name maps to the
+# mesh axis that shards it; anything absent resolves replicated. Activation
+# names ('batch', 'length') and parameter names ('embed', 'heads', …) share
+# one table so data and weights can never disagree about an axis.
+DEFAULT_RULES = (
+    ('batch', 'dp'),
+    ('length', 'sp'),
+    ('vocab', 'mp'),
+    ('heads', 'mp'),
+    ('mlp', 'mp'),
+    ('kv', None),
+    ('expert', 'ep'),
+    ('layers', 'pp'),
+    ('embed', None),
+)
+
+
+def _degree(mesh, axes):
+    d = 1
+    for a in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+        d *= mesh.shape.get(a, 1)
+    return d
+
+
+@dataclasses.dataclass(frozen=True)
+class Partitioner:
+    """An ordered logical→mesh rules table, optionally bound to a mesh.
+
+    rules: sequence of ``(logical_name, mesh_axis)`` where mesh_axis is a
+    str, a tuple of str (sharded over several axes), or None (replicated).
+    """
+    rules: tuple = DEFAULT_RULES
+    mesh: object = None
+
+    def __post_init__(self):
+        object.__setattr__(self, 'rules', tuple(
+            (str(name), tuple(ax) if isinstance(ax, list) else ax)
+            for name, ax in self.rules))
+        if self.mesh is not None:
+            names = set(self.mesh.axis_names)
+            for name, ax in self.rules:
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    if a is not None and a not in names:
+                        raise ShardingRuleError(
+                            f"rule ({name!r} -> {ax!r}) names mesh axis "
+                            f"{a!r} not in mesh axes {sorted(names)}")
+
+    # ---- core resolution -------------------------------------------------
+    def spec(self, logical_axes, shape=None):
+        """Resolve a tuple of logical axis names to a PartitionSpec.
+
+        With ``shape`` (same length), each resolved dim is checked to
+        divide its mesh degree — mismatches raise instead of silently
+        padding."""
+        if logical_axes is None:
+            return PartitionSpec()
+        if isinstance(logical_axes, PartitionSpec):
+            return logical_axes           # already-resolved escape hatch
+        if shape is not None and len(shape) != len(logical_axes):
+            raise ShardingRuleError(
+                f'shape {tuple(shape)} has {len(shape)} dims but logical '
+                f'axes {logical_axes} name {len(logical_axes)}')
+        taken = set()
+        out = []
+        for d, name in enumerate(logical_axes):
+            resolved = None
+            if name is not None:
+                for rname, ax in self.rules:
+                    if rname != name:
+                        continue
+                    axes = ax if isinstance(ax, tuple) else (ax,)
+                    if ax is None or any(a in taken for a in axes):
+                        # explicit replication rule, or the mesh axis is
+                        # already used by an earlier dim: keep scanning
+                        if ax is None:
+                            break
+                        continue
+                    if self.mesh is not None and shape is not None:
+                        deg = _degree(self.mesh, axes)
+                        if deg > 1 and shape[d] % deg != 0:
+                            raise ShardingRuleError(
+                                f'dim {d} ({name!r}) of shape '
+                                f'{tuple(shape)} does not divide mesh '
+                                f'degree {deg} for rule ({name!r} -> '
+                                f'{ax!r})')
+                    resolved = ax
+                    taken.update(axes)
+                    break
+            out.append(resolved)
+        return PartitionSpec(*out)
+
+    def tree_specs(self, logical_tree, tree=None):
+        """Map a pytree of logical-axis tuples to PartitionSpecs. With
+        ``tree`` (matching pytree of arrays), shapes are validated."""
+        is_leaf = lambda x: x is None or isinstance(x, (tuple, PartitionSpec))
+        if tree is None:
+            return jax.tree_util.tree_map(self.spec, logical_tree,
+                                          is_leaf=is_leaf)
+        return jax.tree_util.tree_map(
+            lambda la, x: self.spec(la, getattr(x, 'shape', None)),
+            logical_tree, tree, is_leaf=is_leaf)
+
+    # ---- mesh-bound helpers ---------------------------------------------
+    def _require_mesh(self):
+        if self.mesh is None:
+            raise ShardingRuleError(
+                'this Partitioner has no mesh bound — build it with '
+                'Partitioner(rules, mesh=...) or from_strategy()')
+        return self.mesh
+
+    def sharding(self, logical_axes, shape=None):
+        """NamedSharding for one logical annotation (requires a mesh)."""
+        return NamedSharding(self._require_mesh(),
+                             self.spec(logical_axes, shape))
+
+    def place(self, tree, logical_tree):
+        """device_put a pytree per its resolved specs (host-side)."""
+        mesh = self._require_mesh()
+        specs = self.tree_specs(logical_tree)
+
+        def put(x, s):
+            try:
+                return jax.device_put(x, NamedSharding(mesh, s))
+            except Exception:
+                return x
+        return jax.tree_util.tree_map(put, tree, specs)
+
+    def constrain(self, x, logical_axes):
+        """with_sharding_constraint to the resolved spec (trace-time)."""
+        return jax.lax.with_sharding_constraint(
+            x, self.sharding(logical_axes))
+
+    def place_batch(self, arr, logical=None):
+        """Shard one batch array: dim 0 is 'batch'; remaining dims
+        replicated unless ``logical`` names them."""
+        logical = logical or ('batch',) + (None,) * (arr.ndim - 1)
+        try:
+            return jax.device_put(arr, self.sharding(logical))
+        except Exception:
+            return arr
+
+    # ---- ZeRO (largest-divisible-dim over the data axes) -----------------
+    def data_axes(self):
+        """Mesh axes backing gradient/optimizer (ZeRO) sharding: whatever
+        'batch' resolves to, plus the 'sharding' axis when present and >1."""
+        axes = []
+        for name, ax in self.rules:
+            if name == 'batch' and ax is not None:
+                axes += list(ax if isinstance(ax, tuple) else (ax,))
+                break
+        if self.mesh is not None:
+            if (self.mesh.shape.get('sharding', 1) > 1
+                    and 'sharding' not in axes):
+                axes.append('sharding')
+            axes = [a for a in axes if self.mesh.shape.get(a, 1) > 1]
+        return tuple(axes) or ('dp',)
+
+    def zero_specs(self, tree):
+        """Largest-divisible-dim ZeRO specs over the data axes — the
+        partitioner face of ``parallel.zero`` (one policy, one mechanism)."""
+        from . import zero
+        return zero.zero_specs(tree, self._require_mesh(), self.data_axes())
+
+    def place_zero(self, tree):
+        from . import zero
+        return zero.place(tree, self._require_mesh(), self.data_axes())
+
+    # ---- strategy compilation -------------------------------------------
+    @classmethod
+    def from_strategy(cls, strategy, mesh=None):
+        """Compile a fleet DistributedStrategy into (rules, mesh).
+
+        Validates the hybrid degrees against the device count FIRST
+        (``strategy.validate_degrees``) so a bad dp×mp product fails here
+        with a clear message, not deep inside mesh construction."""
+        from ..distributed.topology import (HybridTopology, get_topology,
+                                            set_topology)
+        # validate_degrees both checks the product divides the device
+        # count and returns the parsed degree dict (0/None handling)
+        deg = strategy.validate_degrees(jax.device_count())
+        if mesh is None:
+            topo = get_topology()
+            if topo is None or any(
+                    topo.axis_size(a) < d for a, d in deg.items() if d > 1):
+                topo = HybridTopology(**deg)
+                set_topology(topo)
+            mesh = topo.mesh
+        rules = list(DEFAULT_RULES)
+        if deg['sharding'] > 1:
+            # the ZeRO 'sharding' axis also carries the batch (paddle's
+            # sharding_degree multiplies the data-parallel ways)
+            rules[0] = ('batch', ('dp', 'sharding'))
+        return cls(rules=tuple(rules), mesh=mesh)
+
+
+def model_rules(mp=1, pp=1, sp=1, ep=1, explicit=False):
+    """Rules table for the in-tree transformer models.
+
+    explicit=False — GSPMD path (jit + sharding propagation): the vocab
+    dim of the tied embedding/head shards over 'mp' and XLA inserts the
+    TP collectives.
+    explicit=True — shard_map path (sp ring attention / pp pipeline):
+    collectives are hand-placed (tp_ad f/g pair, ppermute), every rank
+    computes the embedding/head redundantly, so 'vocab' stays replicated
+    and 'mp'/'pp' only appear when those degrees are real (shard_map
+    in_specs describe the per-rank view exactly).
+    """
+    if explicit:
+        mp_ax = 'mp' if mp > 1 else None
+        vocab_ax = None
+    else:
+        mp_ax = 'mp'
+        vocab_ax = 'mp'
+    return (
+        ('batch', 'dp'),
+        ('length', 'sp' if sp > 1 else None),
+        ('vocab', vocab_ax),
+        ('heads', mp_ax),
+        ('mlp', mp_ax),
+        ('expert', 'ep'),
+        ('layers', 'pp' if pp > 1 else None),
+        ('embed', None),
+    )

@@ -13,6 +13,12 @@ collectives on ICI, overlapped with compute by the XLA scheduler:
   stage 2: + gradients reduce-scattered over dp        -> (os+g)/N
   stage 3: + parameters stored sharded ("FSDP"), XLA   -> (os+g+p)/N
            all-gathers them just-in-time inside fwd/bwd
+
+This module is the *mechanism* (largest-divisible-dim spec construction +
+constraints); the *policy* — which mesh axes back ZeRO, how it composes
+with mp/pp — lives in the partitioner rules table
+(parallel/partitioner.py: ``Partitioner.data_axes``/``zero_specs``), which
+delegates here so placement and per-step constraints always agree.
 """
 import jax
 import jax.numpy as jnp
@@ -102,15 +108,20 @@ def hybrid_zero3_specs(tree, base_specs, mesh=None, dp_axis='dp'):
 
 
 def make_zero_train_step(loss_fn, optimizer, mesh=None, stage=1,
-                         axes=('dp',), batch_axes=('dp',), donate=True):
+                         axes=('dp',), batch_axes=('dp',), donate=True,
+                         partitioner=None):
     """Build (step, init_state) implementing ZeRO stage 1/2/3.
 
     loss_fn(params, *batch) -> scalar loss, pure. The batch's leading dim is
     sharded over ``batch_axes``; params replicated (stage<=2) or sharded
-    (stage 3) over ``axes``.
+    (stage 3) over ``axes``. A ``partitioner`` supplies mesh + axes from
+    its rules table ('batch' resolution) instead of the explicit kwargs.
 
     step(params, opt_state, lr, *batch) -> (loss, params, opt_state)
     """
+    if partitioner is not None:
+        mesh = mesh or partitioner.mesh
+        axes = batch_axes = partitioner.data_axes()
     mesh = mesh or get_mesh()
     if stage not in (1, 2, 3):
         raise ValueError(f'zero stage must be 1/2/3, got {stage}')

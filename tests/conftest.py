@@ -18,3 +18,29 @@ def _seed():
     import paddle_tpu
     paddle_tpu.seed(42)
     yield
+
+
+@pytest.fixture(scope='session')
+def cpu_mesh():
+    """Mesh builder over the 8 virtual CPU devices.
+
+    Returns ``make(dp=, mp=, pp=, sharding=, sp=, ep=)`` building (and
+    installing as the process topology) a HybridTopology with those degrees.
+    Session-scoped: meshes are cached by degree tuple so repeated tests
+    share device layouts instead of re-deriving them.
+    """
+    from paddle_tpu.distributed import topology as topo_mod
+    cache = {}
+
+    def make(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1):
+        key = (dp, mp, pp, sharding, sp, ep)
+        if key not in cache:
+            cache[key] = topo_mod.HybridTopology(
+                dp=dp, mp=mp, pp=pp, sharding=sharding, sp=sp, ep=ep)
+        topo_mod.set_topology(cache[key])
+        return cache[key]
+
+    prev = topo_mod._current
+    yield make
+    if prev is not None:
+        topo_mod.set_topology(prev)

@@ -1,0 +1,122 @@
+"""Sharding/wire audit: resolve every param group through the partitioner
+rules table and account the dp gradient collective bytes per mode.
+
+Prints ONE line of JSON:
+
+  {"mesh": {...}, "params": {group: spec}, "replicated_unintended": [],
+   "bytes": {f32/bf16/int8/int4 + reduction ratios}, "ok": true}
+
+and exits non-zero when either check fails:
+
+  - unintended replication: a >= min_size param whose logical axes name a
+    live (>1-degree) mesh axis with a divisible dim must actually shard,
+  - wire reduction: the quantized dp all-reduce must cut >= 3.5x bytes
+    vs the native f32 gradient wire.
+
+  python tools/shard_check.py                 # dp=2 x mp=4 on 8 CPU devs
+  python tools/shard_check.py --dp 8 --mp 1 --mode int4
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault('XLA_FLAGS',
+                      '--xla_force_host_platform_device_count=8')
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--dp', type=int, default=2)
+    ap.add_argument('--mp', type=int, default=4)
+    ap.add_argument('--mode', default='int8',
+                    choices=('bf16', 'int8', 'int4', 'fp8'))
+    ap.add_argument('--min-reduction', type=float, default=3.5)
+    ap.add_argument('--hidden', type=int, default=256)
+    ap.add_argument('--layers', type=int, default=4)
+    ap.add_argument('--vocab', type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from paddle_tpu.distributed import quant_collectives as qc
+    from paddle_tpu.distributed import topology as topo_mod
+    from paddle_tpu.models import gpt
+
+    topo = topo_mod.set_topology(
+        topo_mod.HybridTopology(dp=args.dp, mp=args.mp))
+    cfg = gpt.GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                        num_layers=args.layers, num_heads=4,
+                        max_seq_len=128, dtype='float32', use_flash=False,
+                        remat=False, mp=args.mp)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    specs = gpt.param_specs(cfg)
+
+    flat_p = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    flat_s = dict(jax.tree_util.tree_flatten_with_path(specs)[0])
+    flat_l = dict(jax.tree_util.tree_flatten_with_path(
+        gpt.LOGICAL_AXES,
+        is_leaf=lambda x: x is None or isinstance(x, tuple))[0])
+    mesh_shape = dict(topo.mesh.shape)
+    rules = dict(gpt._partitioner(cfg, explicit=False).rules)
+
+    def _live(ax):
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        return ax is not None and all(mesh_shape.get(a, 1) > 1 for a in axes)
+
+    resolved, replicated_bad = {}, []
+    for path, p in sorted(flat_p.items(), key=lambda kv: str(kv[0])):
+        name = jax.tree_util.keystr(path)
+        spec = flat_s[path]
+        resolved[name] = [list(ax) if isinstance(ax, tuple) else ax
+                          for ax in spec]
+        if p.size < qc.DEFAULT_MIN_SIZE:
+            continue
+        # unintended replication: a dim whose LOGICAL name maps to a live
+        # mesh axis in the rules table, with a divisible size, must have
+        # actually resolved sharded ('positions'/'embed' style names that
+        # the table deliberately leaves unmapped never trigger this)
+        logical = flat_l[path]
+        for d, lname in enumerate(logical):
+            ax = rules.get(lname)
+            if not _live(ax):
+                continue
+            deg = 1
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                deg *= mesh_shape.get(a, 1)
+            if p.shape[d] % deg == 0 and spec[d] is None:
+                replicated_bad.append(f'{name}[{lname}]')
+
+    n_ranks = args.dp
+    rep = qc.bytes_report(params, n_ranks=max(n_ranks, 2),
+                          modes=('f32', 'bf16', args.mode))
+    red_key = f'reduction_{args.mode}_vs_f32'
+    reduction = rep.get(red_key, 0.0)
+
+    ok = not replicated_bad and reduction >= args.min_reduction
+    out = {
+        'mesh': mesh_shape,
+        'grad_quant': args.mode,
+        'n_ranks': n_ranks,
+        'params': resolved,
+        'replicated_unintended': replicated_bad,
+        'bytes': rep,
+        'min_reduction': args.min_reduction,
+        'ok': ok,
+    }
+    print(json.dumps(out))
+    if replicated_bad:
+        print(f'FAIL: unintended replication: {replicated_bad}',
+              file=sys.stderr)
+    if reduction < args.min_reduction:
+        print(f'FAIL: {red_key} = {reduction} < {args.min_reduction}',
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
