@@ -444,6 +444,136 @@ def _child_decode_cb():
     print(json.dumps(decode_bench.run_bench(requests=8)))
 
 
+def _child_fp8_train():
+    """fp8 training throughput row: tokens/sec of the GPT train step with
+    matmul_precision='fp8' (e4m3 forward / e5m2 gradient qdq, delayed
+    scaling) vs the identical config full-width. On TPU the qdq
+    convert-dot-convert sandwich lowers onto the native fp8 MXU path; on
+    CPU the row runs a tiny config and tracks overhead, not a speed claim."""
+    _arm_watchdog(CONFIG_TIMEOUT_S)
+    _force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+
+    on_cpu = jax.devices()[0].platform == 'cpu'
+    if on_cpu or os.environ.get('BENCH_FP8_TINY') == '1':
+        dims = dict(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64)
+        batch, seq, iters = 2, 64, 8
+        dtype, flash, remat = 'float32', False, False
+    else:
+        dims = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 8
+        dtype, flash, remat = 'bfloat16', True, True
+
+    out = {}
+    for precision in ('none', 'fp8'):
+        cfg = gpt.GPTConfig(dtype=dtype, use_flash=flash, remat=remat,
+                            matmul_precision=precision, **dims)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4)
+        opt_state = opt.functional_init(params)
+        step = gpt.make_train_step(cfg, opt)
+        f8 = gpt.init_fp8_state(cfg) if precision == 'fp8' else None
+        toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                  0, dims['vocab_size'])
+        lr = jnp.asarray(1e-4)
+        state = {'p': params, 's': opt_state, 'f8': f8}
+
+        def one(i):
+            args = (state['p'], state['s']) \
+                + (() if state['f8'] is None else (state['f8'],)) \
+                + (jax.random.PRNGKey(i), lr, toks, toks)
+            res = step(*args)
+            if state['f8'] is None:
+                loss, state['p'], state['s'] = res
+            else:
+                loss, state['p'], state['s'], state['f8'] = res
+            return loss
+
+        for i in range(2):
+            one(i).block_until_ready()
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(iters):
+            loss = one(10 + i)
+        loss.block_until_ready()
+        key = 'fp8_tokens_per_sec' if precision == 'fp8' \
+            else 'base_tokens_per_sec'
+        out[key] = batch * seq * iters / (time.perf_counter() - t0)
+    out['fp8_speedup'] = round(
+        out['fp8_tokens_per_sec'] / out['base_tokens_per_sec'], 3)
+    print(json.dumps(out))
+
+
+def _child_serve_int8wo():
+    """int8 weight-only serving row: per-request p50 latency of
+    ``InferenceEngine(precision='int8_wo')`` vs the f32 engine on a ragged
+    batch stream, plus the pow2-bucket compile fence (the weight-only
+    dequant happens in-trace, so buckets stay shared across precisions)."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    import math
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.serving.engine import InferenceEngine
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(256, 512)
+            self.fc2 = nn.Linear(512, 64)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    rng = np.random.RandomState(0)
+    max_batch = 8
+    sizes = [int(rng.randint(1, max_batch + 1)) for _ in range(64)]
+    out = {}
+    for name, kw in (('f32', {}), ('int8wo', {'precision': 'int8_wo'})):
+        eng = InferenceEngine(net, max_batch_size=max_batch,
+                              autostart=False, **kw)
+        eng.start()
+        try:
+            for b in (1, 2, 4, 8):   # warm every pow2 bucket
+                eng.submit(rng.randn(b, 256).astype('float32')) \
+                   .result(timeout=120)
+            lats = []
+            for n in sizes:
+                x = rng.randn(n, 256).astype('float32')
+                t0 = time.perf_counter()
+                eng.submit(x).result(timeout=120)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            out[f'serve_{name}_p50_ms'] = round(
+                sorted(lats)[len(lats) // 2], 3)
+            if name == 'int8wo':
+                compiles = eng.stats()['compiles']
+                bound = math.ceil(math.log2(max_batch)) + 1
+                out['int8wo_compiles'] = compiles
+                out['compiles_ok'] = compiles <= bound
+        finally:
+            eng.shutdown(drain=False)
+    print(json.dumps(out))
+
+
+def _child_precision_check():
+    """Low-precision gate row: tools/precision_check.py run in-process —
+    fp8-vs-full-width loss parity, int8_wo engine output parity + compile
+    fence, and the int8 bytes-moved claim. The child always exits 0; the
+    parent banks the verdict as precision_check_ok."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import precision_check
+    print(json.dumps(precision_check.run_gate()))
+
+
 def _child_obs_overhead():
     """Observability overhead probe: steps/s of a small hapi fit loop, run
     by the parent twice (PADDLE_TPU_OBS=0 and =1) so the <5% budget of the
@@ -917,6 +1047,34 @@ def main(fast=False):
             print(f'continuous-batching decode bench failed: {cbnote}',
                   file=sys.stderr)
 
+        f8, f8note = _run_child(['--child-fp8-train'], CONFIG_TIMEOUT_S)
+        if f8 is not None:
+            out['fp8_tokens_per_sec'] = round(f8['fp8_tokens_per_sec'], 1)
+            out['fp8_base_tokens_per_sec'] = round(
+                f8['base_tokens_per_sec'], 1)
+            out['fp8_step_speedup'] = f8['fp8_speedup']
+        else:
+            print(f'fp8 train bench failed: {f8note}', file=sys.stderr)
+
+        wo, wonote = _run_child(['--child-serve-int8wo'], PREDICTOR_TIMEOUT_S)
+        if wo is not None:
+            out['serve_int8wo_p50_ms'] = wo['serve_int8wo_p50_ms']
+            out['serve_f32_p50_ms'] = wo['serve_f32_p50_ms']
+            out['serve_int8wo_compiles'] = wo['int8wo_compiles']
+            out['serve_int8wo_compiles_ok'] = wo['compiles_ok']
+        else:
+            print(f'int8_wo serving bench failed: {wonote}', file=sys.stderr)
+
+        pc, pcnote = _run_child(['--child-precision-check'],
+                                PREDICTOR_TIMEOUT_S)
+        if pc is not None:
+            out['precision_check_ok'] = pc['ok']
+            out['fp8_loss_divergence'] = pc['fp8_loss_divergence']
+            out['int8wo_rel_err'] = pc['int8wo_rel_err']
+            out['int8wo_bytes_reduction'] = pc['bytes_reduction']
+        else:
+            print(f'precision gate failed: {pcnote}', file=sys.stderr)
+
         eager, enote = _run_child(['--child-eager'], 180)
         if eager is not None:
             out['eager_ops_per_sec'] = round(eager['eager_ops_per_sec'], 1)
@@ -1042,6 +1200,12 @@ if __name__ == '__main__':
         _child_decode_cb()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-warmup':
         _child_warmup()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-fp8-train':
+        _child_fp8_train()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-serve-int8wo':
+        _child_serve_int8wo()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-precision-check':
+        _child_precision_check()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':
         _child_obs_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

@@ -4,6 +4,13 @@ Reference: python/paddle/amp/auto_cast.py, grad_scaler.py. TPU-native: the
 low-precision dtype defaults to bfloat16 (MXU-native), which needs no loss
 scaling; GradScaler is kept API-compatible and becomes a near-no-op for bf16
 while implementing real dynamic scaling for float16.
+
+One tier below bf16: ``dtype='float8'`` keeps bf16 as the storage/compute
+dtype but quantize-dequantizes white-listed matmul inputs through e4m3
+(quantization/fp8.py), i.e. fp8 numerics with bf16 plumbing. For the jitted
+GPT/MoE train steps use ``GPTConfig(matmul_precision='fp8')`` instead —
+that path carries delayed-scaling state; auto_cast's eager hook uses
+current scaling (no state to carry between dispatches).
 """
 import contextlib
 
@@ -17,20 +24,42 @@ _WHITE = {'linear', 'matmul', 'mm', 'bmm', 'conv1d', 'conv2d', 'conv3d',
 _BLACK = {'softmax', 'log_softmax', 'cross_entropy', 'layer_norm', 'mean', 'sum',
           'exp', 'log', 'softmax_with_cross_entropy'}
 
-_state = {'enable': False, 'level': 'O1', 'dtype': jnp.bfloat16}
+_state = {'enable': False, 'level': 'O1', 'dtype': jnp.bfloat16,
+          'fp8': False}
+
+_DTYPES = {'bfloat16': jnp.bfloat16, 'float16': jnp.float16,
+           # float8: bf16 carries the values, white ops qdq through e4m3
+           'float8': jnp.bfloat16}
 
 
 def amp_state():
     return _state
 
 
+def _amp_signature():
+    """Hashable summary of everything that changes a traced step's amp
+    behavior — folded into hapi's step-cache keys so toggling auto_cast
+    (or its custom lists) retraces instead of reusing a stale step.
+    None when amp is off, so non-amp users share one cache entry."""
+    if not _state['enable']:
+        return None
+    return (_state['level'], str(jnp.dtype(_state['dtype'])),
+            bool(_state.get('fp8')),
+            tuple(sorted(_state.get('white_extra', ()))),
+            tuple(sorted(_state.get('black_extra', ()))))
+
+
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level='O1', dtype='bfloat16'):
+    if dtype not in _DTYPES:
+        raise ValueError(
+            f"auto_cast dtype must be one of {sorted(_DTYPES)}, got {dtype!r}")
     prev = dict(_state)
     _state['enable'] = enable
     _state['level'] = level
-    _state['dtype'] = jnp.bfloat16 if dtype == 'bfloat16' else jnp.float16
+    _state['dtype'] = _DTYPES[dtype]
+    _state['fp8'] = dtype == 'float8'
     if custom_white_list:
         _state['white_extra'] = set(custom_white_list)
     if custom_black_list:
@@ -65,9 +94,24 @@ def _maybe_cast_args(fn_name, args):
         do_cast = fn_name in white
     if not do_cast:
         return args
+    # float8: qdq matmul-class (white) inputs through e4m3 with current
+    # scaling, then carry them in bf16 — fp8 numerics, bf16 plumbing.
+    # O2's cast-everything ops that are merely not-black stay plain bf16.
+    # Routed through apply_op so the autograd tape records the qdq (its
+    # vjp is a cast-back pass-through, the fake-quant STE).
+    fp8_here = _state.get('fp8') and fn_name in white
+    if fp8_here:
+        from ..quantization import fp8 as _fp8
+
+        def _qdq_cast(v):
+            return _fp8.qdq_dynamic(v).astype(lp)
 
     def cast(a):
         if hasattr(a, 'dtype') and a.dtype == jnp.float32:
+            if fp8_here:
+                if isinstance(a, Tensor):
+                    return dispatch.apply_op(_qdq_cast, a)
+                return _qdq_cast(a)
             return a.astype(lp)
         return a
     _in_hook = True
@@ -131,6 +175,31 @@ class GradScaler:
         # re-run autograd and does NOT clear grads (the user does).
         self.step(optimizer)
 
+    def check_fp8(self, fp8_state):
+        """Device-side overflow predicate over an fp8 delayed-scaling state
+        (gpt/moe_gpt ``init_fp8_state`` pytree as updated by the train
+        step). Returns a 0-d bool array — NO host sync happens here, so it
+        composes with the async executor's lazy-loss window; the sync (if
+        any) is the caller's explicit bool()/step_fp8 decision."""
+        from ..quantization import fp8 as _fp8
+        return _fp8.found_inf(fp8_state)
+
+    def step_fp8(self, optimizer, fp8_state):
+        """Skip-step flow for the fp8 train path: read the overflow flag
+        from the fp8 scale state (one host sync, at THIS explicit call),
+        step the optimizer unless an overflow was observed, and run the
+        usual dynamic loss-scale bookkeeping. Returns True when the step
+        was taken."""
+        if not self._enable:
+            optimizer.step()
+            return True
+        self._found_inf = bool(self.check_fp8(fp8_state))
+        took = not self._found_inf
+        if took:
+            optimizer.step()
+        self.update()
+        return took
+
     def update(self):
         if not self._dynamic:
             return
@@ -157,8 +226,13 @@ class GradScaler:
 
 def decorate(models, optimizers=None, level='O2', dtype='bfloat16',
              master_weight=None, save_dtype=None):
-    """O2: cast model params to the low-precision dtype (bf16 on TPU)."""
-    lp = 'bfloat16' if dtype == 'bfloat16' else 'float16'
+    """O2: cast model params to the low-precision dtype (bf16 on TPU).
+    dtype='float8' keeps bf16 STORAGE (fp8 numerics live in the matmul
+    qdq under auto_cast(dtype='float8'), not in the parameters)."""
+    if dtype not in _DTYPES:
+        raise ValueError(
+            f"decorate dtype must be one of {sorted(_DTYPES)}, got {dtype!r}")
+    lp = 'float16' if dtype == 'float16' else 'bfloat16'
     single = not isinstance(models, (list, tuple))
     ms = [models] if single else list(models)
     if level == 'O2':

@@ -373,6 +373,16 @@ class Model:
         self._mode_sig_cache = (mv, sig)
         return sig
 
+    def _amp_sig(self):
+        """Active auto_cast configuration (level/dtype/custom lists), or
+        None when amp is off. The amp hook fires at op dispatch — which
+        includes jit TRACING — so a step traced under one auto_cast config
+        bakes that config in; keying the step caches on this signature
+        makes toggling auto_cast (or editing its lists) retrace instead of
+        silently reusing the stale step."""
+        from .. import amp as _amp
+        return _amp._amp_signature()
+
     # ---- compiled steps --------------------------------------------------
     def _build_train_step(self):
         net = self.network
@@ -478,7 +488,7 @@ class Model:
             # rebuild so the new masks trace into the step
             self._train_steps.clear()
             self._opt_init_pending = True
-        mode_key = self._mode_sig()
+        mode_key = (self._mode_sig(), self._amp_sig())
         fns = self._train_steps.get(mode_key)
         if fns is None:
             self._asp_sig = sig
@@ -569,7 +579,7 @@ class Model:
         # mode: a predict stream with a ragged tail batch (or alternating
         # labeled/unlabeled calls) selects its cached step by shape/dtype
         # tree instead of churning one entry
-        key = (self._mode_sig(),
+        key = (self._mode_sig(), self._amp_sig(),
                tuple((tuple(getattr(a, 'shape', ())),
                       str(getattr(a, 'dtype', ''))) for a in inputs),
                tuple((tuple(getattr(a, 'shape', ())),
@@ -581,7 +591,7 @@ class Model:
         self._eval_step = step
         wm = sys.modules.get('paddle_tpu.warmup.manifest')
         if wm is not None and wm.capturing():
-            wm.record(wm.eval_step_entry(key[1], key[2]))
+            wm.record(wm.eval_step_entry(key[2], key[3]))
         if self._tstate is not None:
             ts = self._ensure_tstate()
             params, buffers = ts.params, ts.buffers

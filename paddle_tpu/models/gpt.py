@@ -100,6 +100,13 @@ class GPTConfig:
     # but 'none' routes the train step through the explicit-collective
     # (shard_map) path so the reduction is addressable.
     grad_quant: str = 'none'
+    # compute precision of the four block matmuls (qkv/proj/fc/out):
+    # 'fp8' runs them e4m3-fwd/e5m2-bwd with per-tensor delayed scaling
+    # (quantization/fp8.py); the train step then threads an fp8_state arg
+    # (init_fp8_state) through the jitted step. Embedding, LM head and
+    # norms stay full precision — they are a sliver of the FLOPs and the
+    # loss is disproportionately sensitive to them.
+    matmul_precision: str = 'none'
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
@@ -107,6 +114,10 @@ class GPTConfig:
             raise ValueError(
                 f"grad_quant must be one of 'none'/'bf16'/'int8'/'int4'/"
                 f"'fp8', got {self.grad_quant!r}")
+        if self.matmul_precision not in ('none', 'fp8'):
+            raise ValueError(
+                f"matmul_precision must be 'none' or 'fp8', "
+                f"got {self.matmul_precision!r}")
 
     @property
     def head_dim(self):
@@ -274,7 +285,17 @@ def _attention(q, k, v, config, mesh=None, drop_seed=None):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
-def _block_qkv(bp, y, nh, hd, cdt, kvh=None):
+def _mm(y, w, cdt, fp8_meta=None):
+    """One block matmul: raw/weight-only via wo_matmul, or — when the
+    caller threads an fp8 scaling meta — the e4m3/e5m2 delayed-scaling
+    primitive (quantization/fp8.py)."""
+    if fp8_meta is None:
+        return wo_matmul(y, w, cdt)
+    from ..quantization import fp8 as _fp8
+    return _fp8.fp8_matmul(y, w.astype(cdt), fp8_meta)
+
+
+def _block_qkv(bp, y, nh, hd, cdt, kvh=None, fp8_meta=None):
     """Fused QKV projection shared by the train block and the KV-cache
     decode block. Packing is per KV HEAD: [q_0..q_{g-1}|k|v] (g = query
     group size; g=1 is classic head-major MHA) — an 'mp' column shard is
@@ -283,20 +304,21 @@ def _block_qkv(bp, y, nh, hd, cdt, kvh=None):
     B, S, _ = y.shape
     kvh = nh if kvh is None else kvh
     g = nh // kvh
-    qkv = wo_matmul(y, bp['qkv_w'], cdt) + bp['qkv_b'].astype(cdt)
+    qkv = _mm(y, bp['qkv_w'], cdt, fp8_meta) + bp['qkv_b'].astype(cdt)
     qkv = qkv.reshape(B, S, kvh, g + 2, hd)
     q = qkv[..., :g, :].reshape(B, S, nh, hd)
     return q, qkv[..., g, :], qkv[..., g + 1, :]
 
 
-def _block_mlp(bp, y, cdt):
+def _block_mlp(bp, y, cdt, fp8_fc=None, fp8_out=None):
     """fc -> gelu -> out projection (bias added by the caller after the
     mp all-reduce)."""
-    y = jax.nn.gelu(wo_matmul(y, bp['fc_w'], cdt) + bp['fc_b'].astype(cdt))
-    return wo_matmul(y, bp['out_w'], cdt)
+    y = jax.nn.gelu(_mm(y, bp['fc_w'], cdt, fp8_fc) + bp['fc_b'].astype(cdt))
+    return _mm(y, bp['out_w'], cdt, fp8_out)
 
 
-def block_fn(bp, x, config, explicit_mp=False, drop_seed=None):
+def block_fn(bp, x, config, explicit_mp=False, drop_seed=None,
+             fp8_meta=None):
     """One transformer block. bp: this layer's params (no leading L dim).
     x: [B, S, H]. With ``explicit_mp`` (inside shard_map), qkv/fc weights are
     the local 'mp' shards and the two row-parallel matmuls psum over 'mp' —
@@ -311,13 +333,14 @@ def block_fn(bp, x, config, explicit_mp=False, drop_seed=None):
     if mp > 1:
         from ..parallel.tp_ad import f_identity, g_allreduce
 
+    fm = fp8_meta or {}
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
     if mp > 1:
         y = f_identity(y, 'mp')
-    q, k, v = _block_qkv(bp, y, nh, hd, cdt, kvh)
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt, kvh, fp8_meta=fm.get('qkv'))
     a = _attention(q, k, v, config,
                    drop_seed=drop_seed).reshape(B, S, h // mp)
-    a = wo_matmul(a, bp['proj_w'], cdt)
+    a = _mm(a, bp['proj_w'], cdt, fm.get('proj'))
     if mp > 1:
         a = g_allreduce(a, 'mp')
     x = x + a + bp['proj_b'].astype(cdt)
@@ -325,19 +348,22 @@ def block_fn(bp, x, config, explicit_mp=False, drop_seed=None):
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     if mp > 1:
         y = f_identity(y, 'mp')
-    y = _block_mlp(bp, y, cdt)
+    y = _block_mlp(bp, y, cdt, fp8_fc=fm.get('fc'), fp8_out=fm.get('out'))
     if mp > 1:
         y = g_allreduce(y, 'mp')
     x = x + y + bp['out_b'].astype(cdt)
     return x
 
 
-def forward_hidden(params, tokens, config: GPTConfig, dropout_seed=None):
+def forward_hidden(params, tokens, config: GPTConfig, dropout_seed=None,
+                   fp8_state=None):
     """tokens: [B, S] int32 -> final hidden states [B, S, H] (pre-LM-head).
     dropout_seed (traced u32 scalar, training only): enables
     config.dropout attention dropout with a distinct derived seed per
     layer; None (the serving/eval default) disables it with an UNCHANGED
-    trace."""
+    trace. fp8_state (init_fp8_state, training only): per-layer delayed
+    scaling metas riding the scan xs next to the stacked block params —
+    grads w.r.t. it are the UPDATED state (quantization/fp8.py)."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     pos = jnp.arange(S)
@@ -348,16 +374,30 @@ def forward_hidden(params, tokens, config: GPTConfig, dropout_seed=None):
     if config.remat:
         body = _remat(body, config)
 
-    if config.dropout > 0.0 and dropout_seed is not None:
+    use_drop = config.dropout > 0.0 and dropout_seed is not None
+    if use_drop:
         # one derived seed per layer, riding the scan as an extra xs — the
         # scan call and epilogue below are shared with the no-dropout path
         from ..ops.flash_attention import per_layer_seeds
         seeds = per_layer_seeds(dropout_seed, config.num_layers)
+    if use_drop and fp8_state is not None:
+        xs = (params['blocks'], seeds, fp8_state['blocks'])
+
+        def scan_body(carry, inp):
+            bp, sd, fm = inp
+            return body(bp, carry, drop_seed=sd, fp8_meta=fm), None
+    elif use_drop:
         xs = (params['blocks'], seeds)
 
         def scan_body(carry, inp):
             bp, sd = inp
             return body(bp, carry, drop_seed=sd), None
+    elif fp8_state is not None:
+        xs = (params['blocks'], fp8_state['blocks'])
+
+        def scan_body(carry, inp):
+            bp, fm = inp
+            return body(bp, carry, fp8_meta=fm), None
     else:
         xs = params['blocks']
 
@@ -375,9 +415,11 @@ def forward(params, tokens, config: GPTConfig, dropout_seed=None):
     return wo_lm_head(x, params['wte'], x.dtype)
 
 
-def loss_fn(params, tokens, targets, config: GPTConfig, dropout_key=None):
+def loss_fn(params, tokens, targets, config: GPTConfig, dropout_key=None,
+            fp8_state=None):
     """dropout_key: PRNG key (train step's ``key``) — consumed only when
-    config.dropout > 0 (the trace is unchanged otherwise)."""
+    config.dropout > 0 (the trace is unchanged otherwise). fp8_state: see
+    forward_hidden."""
     seed = (jax.random.bits(dropout_key, (1,), jnp.uint32)[0]
             if config.dropout > 0.0 and dropout_key is not None else None)
     if (config.xent_chunk and config.mp == 1 and config.sp == 1
@@ -386,15 +428,34 @@ def loss_fn(params, tokens, targets, config: GPTConfig, dropout_key=None):
         # blockwise LM-head loss: never materializes [B,S,V] logits (the
         # other HBM hog besides attention) — see ops/xent.py
         from ..ops.xent import softmax_xent_blockwise
-        x = forward_hidden(params, tokens, config, dropout_seed=seed)
+        x = forward_hidden(params, tokens, config, dropout_seed=seed,
+                           fp8_state=fp8_state)
         B, S, H = x.shape
         return softmax_xent_blockwise(x.reshape(B * S, H), params['wte'],
                                       targets.reshape(B * S),
                                       config.xent_chunk)
-    logits = forward(params, tokens, config, dropout_seed=seed)
+    x = forward_hidden(params, tokens, config, dropout_seed=seed,
+                       fp8_state=fp8_state)
+    logits = wo_lm_head(x, params['wte'], x.dtype)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# fp8 training state -------------------------------------------------------
+
+FP8_MATMULS = ('qkv', 'proj', 'fc', 'out')
+
+
+def init_fp8_state(config: GPTConfig):
+    """Delayed-scaling state for matmul_precision='fp8': one
+    {x, w, g} x {scale, amax-history} meta per block matmul, stacked on
+    the layer dim so it scans alongside params['blocks']. Pass it to the
+    fp8 train step (make_train_step) as the third argument; the step
+    returns the updated state in the same structure (donation-safe)."""
+    from ..quantization import fp8 as _fp8
+    return {'blocks': {name: _fp8.init_matmul_meta(config.num_layers)
+                       for name in FP8_MATMULS}}
 
 
 # ---------------------------------------------------------------------------
@@ -764,6 +825,31 @@ def make_train_step(config: GPTConfig, optimizer, mesh=None):
         raise NotImplementedError(
             'attention dropout under pipeline parallelism is not '
             'implemented — set dropout=0, or use dp/mp/sp layouts')
+
+    fp8 = getattr(config, 'matmul_precision', 'none') == 'fp8'
+    if fp8 and use_shard_map:
+        raise NotImplementedError(
+            "matmul_precision='fp8' under the explicit-collective "
+            '(shard_map) layouts (sp/pp/grad_quant) is not implemented — '
+            'use the GSPMD dp/mp path or matmul_precision=none')
+
+    if fp8:
+        # fp8 step: the delayed-scaling state is an explicit third arg and
+        # output — step(params, opt_state, fp8_state, key, lr, tokens,
+        # targets) -> (loss, params, opt_state, fp8_state). The new state
+        # arrives as the GRADIENT of the old one (quantization/fp8.py), so
+        # one backward pass yields grads and state with no side channel,
+        # no host sync, and donation-compatible buffers.
+        def step(params, opt_state, fp8_state, key, lr, tokens, targets):
+            loss, (grads, new_fp8) = jax.value_and_grad(
+                lambda p, f8: loss_fn(p, tokens, targets, config,
+                                      key if config.dropout > 0.0 else None,
+                                      fp8_state=f8),
+                argnums=(0, 1))(params, fp8_state)
+            new_p, new_s = optimizer.functional_apply(params, grads,
+                                                      opt_state, lr)
+            return loss, new_p, new_s, new_fp8
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     if not use_shard_map:
         def step(params, opt_state, key, lr, tokens, targets):

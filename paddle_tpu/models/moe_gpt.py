@@ -16,7 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.weight_only import is_weight_only, wo_lm_head, wo_matmul, wo_take
 from ..parallel.moe import moe_ffn
-from .gpt import (_layer_norm, _attention, _block_qkv,
+from .gpt import (_layer_norm, _attention, _block_qkv, _mm,
                   cached_attention, validate_gqa)
 
 
@@ -53,9 +53,19 @@ class MoEConfig:
     xent_chunk: int = 8192
     # serving: int8 KV cache with per-row scales — see gpt.GPTConfig
     kv_cache_int8: bool = False
+    # 'fp8' runs the dense attention matmuls (qkv/proj) e4m3-fwd/e5m2-bwd
+    # with delayed scaling (see gpt.GPTConfig.matmul_precision); the
+    # capacity-bucketed expert einsums stay in the compute dtype — their
+    # dispatch/combine contractions are not plain matmuls and per-tensor
+    # scales across ragged expert loads are ill-conditioned.
+    matmul_precision: str = 'none'
 
     def __post_init__(self):
         validate_gqa(self.num_heads, self.num_kv_heads, self.mp)
+        if self.matmul_precision not in ('none', 'fp8'):
+            raise ValueError(
+                f"matmul_precision must be 'none' or 'fp8', "
+                f"got {self.matmul_precision!r}")
 
     @property
     def head_dim(self):
@@ -127,15 +137,18 @@ def param_specs(config: MoEConfig):
         LOGICAL_AXES)
 
 
-def block_fn(bp, carry, config, drop_seed=None):
+def block_fn(bp, carry, config, drop_seed=None, fp8_meta=None):
     x, aux_acc = carry
     cdt = jnp.dtype(config.dtype)
     B, S, h = x.shape
     nh, hd = config.num_heads, config.head_dim
+    fm = fp8_meta or {}
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
-    q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
+    q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads,
+                         fp8_meta=fm.get('qkv'))
     a = _attention(q, k, v, config, drop_seed=drop_seed).reshape(B, S, h)
-    x = x + wo_matmul(a, bp['proj_w'], cdt) + bp['proj_b'].astype(cdt)
+    x = (x + _mm(a, bp['proj_w'], cdt, fm.get('proj'))
+         + bp['proj_b'].astype(cdt))
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, aux = moe_ffn(y, bp['gate_w'].astype(cdt),
                       _c(bp['w_in'], cdt), _c(bp['w_out'], cdt),
@@ -143,9 +156,12 @@ def block_fn(bp, carry, config, drop_seed=None):
     return (x + ff, aux_acc + aux), None
 
 
-def forward_hidden(params, tokens, config, dropout_seed=None):
+def forward_hidden(params, tokens, config, dropout_seed=None,
+                   fp8_state=None):
     """-> (final hidden [B,S,H], aux load-balance loss). dropout_seed: see
-    gpt.forward_hidden (per-layer mixed seeds; None = unchanged trace)."""
+    gpt.forward_hidden (per-layer mixed seeds; None = unchanged trace).
+    fp8_state (init_fp8_state): per-layer qkv/proj delayed-scaling metas
+    riding the scan xs — see gpt.forward_hidden."""
     cdt = jnp.dtype(config.dtype)
     B, S = tokens.shape
     x = (wo_take(params['wte'], tokens) +
@@ -154,13 +170,25 @@ def forward_hidden(params, tokens, config, dropout_seed=None):
     if config.remat:
         body = jax.checkpoint(body)
     carry0 = (x, jnp.zeros((), jnp.float32))
-    if config.dropout > 0.0 and dropout_seed is not None:
+    use_drop = config.dropout > 0.0 and dropout_seed is not None
+    if use_drop:
         from ..ops.flash_attention import per_layer_seeds
-        xs = (params['blocks'],
-              per_layer_seeds(dropout_seed, config.num_layers))
+        seeds = per_layer_seeds(dropout_seed, config.num_layers)
+    if use_drop and fp8_state is not None:
+        xs = (params['blocks'], seeds, fp8_state['blocks'])
+
+        def scan_body(c, inp):
+            return body(inp[0], c, drop_seed=inp[1], fp8_meta=inp[2])
+    elif use_drop:
+        xs = (params['blocks'], seeds)
 
         def scan_body(c, inp):
             return body(inp[0], c, drop_seed=inp[1])
+    elif fp8_state is not None:
+        xs = (params['blocks'], fp8_state['blocks'])
+
+        def scan_body(c, inp):
+            return body(inp[0], c, fp8_meta=inp[1])
     else:
         xs = params['blocks']
 
@@ -176,7 +204,19 @@ def forward(params, tokens, config, dropout_seed=None):
     return wo_lm_head(x, params['wte'], x.dtype), aux
 
 
-def loss_fn(params, tokens, targets, config, dropout_key=None):
+FP8_MATMULS = ('qkv', 'proj')
+
+
+def init_fp8_state(config: 'MoEConfig'):
+    """Delayed-scaling state for matmul_precision='fp8' (dense qkv/proj
+    matmuls only — see MoEConfig). Same contract as gpt.init_fp8_state."""
+    from ..quantization import fp8 as _fp8
+    return {'blocks': {name: _fp8.init_matmul_meta(config.num_layers)
+                       for name in FP8_MATMULS}}
+
+
+def loss_fn(params, tokens, targets, config, dropout_key=None,
+            fp8_state=None):
     seed = (jax.random.bits(dropout_key, (1,), jnp.uint32)[0]
             if config.dropout > 0.0 and dropout_key is not None else None)
     aux_scale = config.aux_weight / config.num_layers
@@ -185,13 +225,16 @@ def loss_fn(params, tokens, targets, config, dropout_key=None):
             and config.vocab_size % config.xent_chunk == 0):
         # blockwise LM-head loss (ops/xent.py): no [B,S,V] logits in HBM
         from ..ops.xent import softmax_xent_blockwise
-        x, aux = forward_hidden(params, tokens, config, seed)
+        x, aux = forward_hidden(params, tokens, config, seed,
+                                fp8_state=fp8_state)
         B, S, H = x.shape
         ce = softmax_xent_blockwise(x.reshape(B * S, H), params['wte'],
                                     targets.reshape(B * S),
                                     config.xent_chunk)
         return ce + aux_scale * aux
-    logits, aux = forward(params, tokens, config, seed)
+    x, aux = forward_hidden(params, tokens, config, seed,
+                            fp8_state=fp8_state)
+    logits = wo_lm_head(x, params['wte'], x.dtype)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + aux_scale * aux
@@ -379,6 +422,21 @@ def _generate_loop_for(config, temperature, top_k, top_p):
 def make_train_step(config, optimizer, mesh=None):
     from ..distributed.topology import get_mesh
     mesh = mesh or get_mesh()
+
+    if getattr(config, 'matmul_precision', 'none') == 'fp8':
+        # fp8 step: delayed-scaling state (init_fp8_state) is an extra
+        # donated carry; its "gradient" IS the updated state (see
+        # quantization/fp8.py), so one backward pass yields both.
+        def fp8_step(params, opt_state, fp8_state, key, lr, tokens, targets):
+            loss, (grads, new_fp8) = jax.value_and_grad(
+                lambda p, f8: loss_fn(p, tokens, targets, config,
+                                      key if config.dropout > 0.0 else None,
+                                      fp8_state=f8),
+                argnums=(0, 1))(params, fp8_state)
+            new_p, new_s = optimizer.functional_apply(params, grads,
+                                                      opt_state, lr)
+            return loss, new_p, new_s, new_fp8
+        return jax.jit(fp8_step, donate_argnums=(0, 1, 2))
 
     def step(params, opt_state, key, lr, tokens, targets):
         # the step key drives attention dropout when configured

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from ..core.dispatch import op, apply_op
 from .layer_base import Layer
-from .layer_common import Linear
+from .layer_common import Embedding, Linear
 from .layer_conv import Conv2D
 
 
@@ -134,7 +134,7 @@ class WeightOnlyLinear(Layer):
     The int8/scale pair are BUFFERS: they serialize through state_dict /
     jit.save and are constants to the autograd tape."""
 
-    def __init__(self, layer):
+    def __init__(self, layer, act_scale=None, act_bits=8):
         super().__init__()
         from ..core.tensor import Tensor
         from ..ops.weight_only import quantize_weight
@@ -144,8 +144,21 @@ class WeightOnlyLinear(Layer):
         self.bias = layer.bias
         self.in_features = layer.in_features
         self.out_features = layer.out_features
+        # calibrated activation quantization (PTQ convert_calibrated):
+        # when a scale was observed, inputs fake-quant against it so the
+        # served numerics match the calibrated int8 activation grid
+        self.act_bits = act_bits
+        if act_scale is not None:
+            self.register_buffer('act_scale',
+                                 Tensor(jnp.float32(act_scale)))
+        else:
+            self.act_scale = None
 
     def forward(self, x):
+        if self.act_scale is not None:
+            x = fake_quantize_moving_average_abs_max(
+                x, self.act_scale._value, self.act_bits)
+
         def pure(xv, qv, sv, bv=None):
             y = (xv @ qv.astype(xv.dtype)) * sv.astype(xv.dtype)
             return y if bv is None else y + bv.astype(xv.dtype)
@@ -165,7 +178,7 @@ class WeightOnlyConv2D(Layer):
     channel — the same epilogue position as the bias — so XLA streams int8
     weight bytes and fuses the dequant. Eval/serving only."""
 
-    def __init__(self, layer):
+    def __init__(self, layer, act_scale=None, act_bits=8):
         super().__init__()
         from ..core.tensor import Tensor
         from ..ops.weight_only import quantize_weight
@@ -176,9 +189,18 @@ class WeightOnlyConv2D(Layer):
         for a in ('_stride', '_padding', '_dilation', '_groups',
                   '_data_format'):
             setattr(self, a, getattr(layer, a))
+        self.act_bits = act_bits
+        if act_scale is not None:
+            self.register_buffer('act_scale',
+                                 Tensor(jnp.float32(act_scale)))
+        else:
+            self.act_scale = None
 
     def forward(self, x):
         from .functional.conv import _conv
+        if self.act_scale is not None:
+            x = fake_quantize_moving_average_abs_max(
+                x, self.act_scale._value, self.act_bits)
         st, pd, dl, gp, df = (self._stride, self._padding, self._dilation,
                               self._groups, self._data_format)
         channels_last = df.endswith('C')    # 'NHWC'; 'NCHW' ends with 'W'
@@ -197,7 +219,40 @@ class WeightOnlyConv2D(Layer):
         return apply_op(pure, *args)
 
 
-_WO_WRAPPERS = ((Linear, WeightOnlyLinear), (Conv2D, WeightOnlyConv2D))
+class WeightOnlyEmbedding(Layer):
+    """Serving-time Embedding with an int8 table and one f32 scale per ROW
+    (per-token-id): lookups stream int8 rows out of HBM and dequantize in
+    registers. padding_idx rows zero exactly, matching F.embedding."""
+
+    def __init__(self, layer):
+        super().__init__()
+        from ..core.tensor import Tensor
+        from ..ops.weight_only import quantize_weight
+        q = quantize_weight(layer.weight._value, reduce_axis=1)
+        self.register_buffer('weight_int8', Tensor(q['int8']))
+        self.register_buffer('weight_scale', Tensor(q['scale']))
+        self._padding_idx = layer._padding_idx
+
+    def forward(self, x):
+        pad = self._padding_idx
+
+        def pure(idx, qv, sv):
+            idx = jnp.asarray(idx).astype(jnp.int32)
+            rows = (jnp.take(qv, idx, axis=0).astype(sv.dtype)
+                    * jnp.take(sv, idx, axis=0)[..., None])
+            if pad is not None:
+                rows = jnp.where((idx == pad)[..., None], 0.0, rows)
+            return rows
+        return apply_op(pure, x, self.weight_int8, self.weight_scale)
+
+    def extra_repr(self):
+        v, h = self.weight_int8.shape
+        return f'num_embeddings={v}, embedding_dim={h}, weight=int8'
+
+
+_WO_WRAPPERS = ((Linear, WeightOnlyLinear), (Conv2D, WeightOnlyConv2D),
+                (Embedding, WeightOnlyEmbedding))
+_WO_TYPES = (WeightOnlyLinear, WeightOnlyConv2D, WeightOnlyEmbedding)
 
 
 def weight_only_quantize(model, layer_types=(Linear, Conv2D)):
@@ -212,12 +267,11 @@ def weight_only_quantize(model, layer_types=(Linear, Conv2D)):
     if bad:
         raise TypeError(
             f'weight_only_quantize: {[t.__name__ for t in bad]} are not '
-            'Linear/Conv2D subclasses — only those weight layouts have a '
-            'weight-only int8 form here')
+            'Linear/Conv2D/Embedding subclasses — only those weight '
+            'layouts have a weight-only int8 form here')
     types = tuple(layer_types)
     for name, sub in list(model._sub_layers.items()):
-        if isinstance(sub, (WeightOnlyLinear, WeightOnlyConv2D,
-                            _QuantWrapperBase)):
+        if isinstance(sub, _WO_TYPES + (_QuantWrapperBase,)):
             # QAT/PTQ wrappers already model int8 numerics (and their inner
             # layer's weight must stay live for the fake-quant forward)
             continue
@@ -228,6 +282,37 @@ def weight_only_quantize(model, layer_types=(Linear, Conv2D)):
                     break
         else:
             weight_only_quantize(sub, layer_types=layer_types)
+    return model
+
+
+def convert_calibrated(model):
+    """Swap calibrated QAT/PTQ wrappers (``_QuantWrapperBase``) for real
+    weight-only int8 layers in place: the inner layer's weight is snapshot
+    to int8 + per-output-channel scales, and an observed activation scale
+    (``_act_scale`` > 0) rides along so inputs fake-quant against the
+    calibrated grid. This is the conversion step the reference's
+    ``quant_post_dynamic`` / ``PostTrainingQuantization.quantize()``
+    perform — after it, the model genuinely serves int8 weights."""
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, _QuantWrapperBase):
+            act_scale = None
+            if hasattr(sub, '_act_scale'):
+                s = float(sub._act_scale._value)
+                if s > 0:
+                    act_scale = s
+            inner = sub.inner
+            if isinstance(inner, Linear):
+                model._sub_layers[name] = WeightOnlyLinear(
+                    inner, act_scale=act_scale, act_bits=sub.activation_bits)
+            elif isinstance(inner, Conv2D):
+                model._sub_layers[name] = WeightOnlyConv2D(
+                    inner, act_scale=act_scale, act_bits=sub.activation_bits)
+            else:
+                # no weight-only form for this layout: drop the wrapper,
+                # keep the full-precision inner layer
+                model._sub_layers[name] = inner
+        else:
+            convert_calibrated(sub)
     return model
 
 

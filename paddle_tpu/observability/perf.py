@@ -45,6 +45,8 @@ from .trace import record_event
 
 ENV_PEAK_FLOPS = 'PADDLE_TPU_PEAK_FLOPS'
 ENV_PEAK_BW = 'PADDLE_TPU_PEAK_BW'
+ENV_PEAK_FLOPS_FP8 = 'PADDLE_TPU_PEAK_FLOPS_FP8'
+ENV_PEAK_FLOPS_INT8 = 'PADDLE_TPU_PEAK_FLOPS_INT8'
 
 # (peak_flops/s, peak_HBM_bytes/s) by device-kind substring, checked in
 # order. FLOPs numbers match bench.py's PEAK_FLOPS; 'cpu' is nominal so
@@ -57,6 +59,30 @@ PEAKS = (
     ('cpu', (1e12, 100e9)),
 )
 _DEFAULT_PEAKS = (197e12, 0.82e12)      # unknown accelerator: v5e numbers
+
+# Per-precision peak FLOPs by device-kind substring: an fp8/int8 step
+# measured against the bf16 peak would report a flattering MFU on parts
+# whose MXU doubles low-precision throughput. Kinds absent here fall back
+# to the base peak (conservative: MFU can only read lower, never inflated).
+PRECISION_PEAKS = (
+    ('v6e', {'fp8': 1836e12, 'int8': 1836e12}),
+    ('v5p', {'int8': 918e12}),
+    ('v5e', {'int8': 394e12}),
+)
+_PRECISION_ENV = {'fp8': ENV_PEAK_FLOPS_FP8, 'int8': ENV_PEAK_FLOPS_INT8}
+
+
+def _norm_precision(precision):
+    """Collapse precision spellings onto the peak-table keys: fp8 training
+    and int8 weight-only serving share MXU families with 'fp8'/'int8';
+    full/half-width precisions use the base (bf16) peak -> None."""
+    if precision in (None, 'none', 'float32', 'bfloat16', 'float16'):
+        return None
+    if precision in ('fp8', 'float8'):
+        return 'fp8'
+    if precision in ('int8', 'int8_wo'):
+        return 'int8'
+    return None
 
 _lock = threading.Lock()
 _records = {}            # label -> roofline record dict
@@ -85,10 +111,13 @@ def _device_kind():
     return _kind_cache
 
 
-def peaks(kind=None):
+def peaks(kind=None, precision=None):
     """-> ``(peak_flops_per_s, peak_bw_bytes_per_s, source)`` for a device
     kind (default: device 0). Env overrides win over the table; source is
-    'env', 'table', or 'default'."""
+    'env', 'table', or 'default'. ``precision`` ('fp8'/'float8',
+    'int8'/'int8_wo') swaps in that precision's peak FLOPs where the part
+    has one (``PRECISION_PEAKS``; ``PADDLE_TPU_PEAK_FLOPS_FP8``/``_INT8``
+    env overrides win) so MFU denominators stay honest per precision."""
     env_f = os.environ.get(ENV_PEAK_FLOPS)
     env_b = os.environ.get(ENV_PEAK_BW)
     kind = (kind or _device_kind()).lower()
@@ -104,6 +133,16 @@ def peaks(kind=None):
         flops, source = float(env_f), 'env'
     if env_b:
         bw, source = float(env_b), 'env'
+    prec = _norm_precision(precision)
+    if prec is not None:
+        env_p = os.environ.get(_PRECISION_ENV[prec])
+        if env_p:
+            flops, source = float(env_p), 'env'
+        else:
+            for sub, table in PRECISION_PEAKS:
+                if sub in kind and prec in table:
+                    flops, source = table[prec], 'table'
+                    break
     return flops, bw, source
 
 
@@ -168,12 +207,15 @@ def _cost_convention():
     return _convention
 
 
-def analyze_compiled(label, compiled):
+def analyze_compiled(label, compiled, precision=None):
     """Publish one compiled executable's static costs under ``fn=label``.
     All figures are PER CHIP (see module docstring) so the roofline/MFU
     join against the per-chip peak table stays honest under a mesh.
-    Returns the roofline record (also stored for ``note_step``/``report``)
-    or ``None`` when disabled / the runtime exposes no cost model."""
+    ``precision`` tags the series (``precision=fp8/int8``) and selects that
+    precision's peak for the roofline verdict; None keeps the legacy
+    untagged series. Returns the roofline record (also stored for
+    ``note_step``/``report``) or ``None`` when disabled / the runtime
+    exposes no cost model."""
     if not cfg.enabled:
         return None
     try:
@@ -185,11 +227,14 @@ def analyze_compiled(label, compiled):
     if n_dev > 1 and _cost_convention() == 'total':
         flops, nbytes = flops / n_dev, nbytes / n_dev
         mem = {k: v // n_dev for k, v in mem.items()}
-    peak_f, peak_bw, _ = peaks()
+    prec = _norm_precision(precision)
+    peak_f, peak_bw, _ = peaks(precision=prec)
     ridge = peak_f / peak_bw
     intensity = flops / nbytes if nbytes else 0.0
     bound_by = 'compute' if intensity >= ridge else 'memory'
     lbl = {'fn': label}
+    if prec is not None:
+        lbl['precision'] = prec
     reg = _registry()
     reg.gauge('perf.flops', lbl).set(flops)
     reg.gauge('perf.devices', lbl).set(n_dev)
@@ -198,21 +243,23 @@ def analyze_compiled(label, compiled):
     reg.gauge('perf.compute_bound', lbl).set(
         1.0 if bound_by == 'compute' else 0.0)
     for kind, v in mem.items():
-        reg.gauge('perf.hbm_bytes', {'fn': label, 'kind': kind}).set(v)
+        mlbl = dict(lbl)
+        mlbl['kind'] = kind
+        reg.gauge('perf.hbm_bytes', mlbl).set(v)
     reg.gauge('perf.peak_flops').set(peak_f)
     reg.gauge('perf.peak_bw').set(peak_bw)
     reg.gauge('perf.ridge').set(round(ridge, 4))
     rec = {'fn': label, 'flops': flops, 'bytes_accessed': nbytes,
            'n_devices': n_dev, 'intensity': round(intensity, 4),
            'bound_by': bound_by, 'hbm': mem, 'mfu': None,
-           'step_ms_p50': None}
+           'step_ms_p50': None, 'precision': prec}
     with _lock:
         _records[label] = rec
         _mfu_handles.pop(label, None)
     return rec
 
 
-def analyze(label, jitted, args=(), kwargs=None):
+def analyze(label, jitted, args=(), kwargs=None, precision=None):
     """Analyze a jitted callable at a signature it has already executed.
 
     Passing the *same concrete arguments* as the live call guarantees
@@ -228,7 +275,7 @@ def analyze(label, jitted, args=(), kwargs=None):
     except Exception:
         _registry().counter('perf.analyze_errors', {'fn': label}).inc()
         return None
-    return analyze_compiled(label, compiled)
+    return analyze_compiled(label, compiled, precision=precision)
 
 
 def analyzed(label):
@@ -238,11 +285,13 @@ def analyzed(label):
         return _records.get(label)
 
 
-def note_step(label, seconds):
+def note_step(label, seconds, precision=None):
     """Join a measured wall-time with ``label``'s static per-chip FLOPs:
     observes ``perf.step_ms{fn}`` and sets ``perf.mfu{fn}`` (per-chip —
-    mesh-width invariant) + the headline ``perf.mfu`` gauge. No-op (still
-    timing-safe) before ``analyze``."""
+    mesh-width invariant) + the headline ``perf.mfu`` gauge. The MFU
+    denominator uses the record's precision peak (``analyze``'s
+    ``precision=``, overridable here). No-op (still timing-safe) before
+    ``analyze``."""
     if not cfg.enabled or seconds <= 0:
         return None
     with _lock:
@@ -250,16 +299,19 @@ def note_step(label, seconds):
         handles = _mfu_handles.get(label)
     if rec is None:
         return None
+    prec = _norm_precision(precision) or rec.get('precision')
     if handles is None:
         reg = _registry()
         lbl = {'fn': label}
+        if prec is not None:
+            lbl['precision'] = prec
         handles = (reg.gauge('perf.mfu', lbl), reg.gauge('perf.mfu'),
                    reg.histogram('perf.step_ms', lbl),
                    reg.gauge('perf.achieved_flops', lbl))
         with _lock:
             _mfu_handles[label] = handles
     mfu_g, mfu_top, step_h, ach_g = handles
-    peak_f, _, _ = peaks()
+    peak_f, _, _ = peaks(precision=prec)
     achieved = rec['flops'] / seconds
     mfu = achieved / peak_f
     step_h.observe(1e3 * seconds)

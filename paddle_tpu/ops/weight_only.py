@@ -29,8 +29,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ['quantize_weight', 'dequantize_weight', 'is_weight_only',
-           'wo_matmul', 'wo_take', 'wo_lm_head', 'quantize_kv',
-           'dequantize_kv']
+           'quantize_param', 'dequantize_param', 'wo_matmul', 'wo_take',
+           'wo_lm_head', 'quantize_kv', 'dequantize_kv']
 
 
 def quantize_weight(w, reduce_axis):
@@ -52,6 +52,25 @@ def dequantize_weight(w, reduce_axis):
 
 def is_weight_only(w):
     return isinstance(w, dict) and 'int8' in w and 'scale' in w
+
+
+def quantize_param(w, reduce_axis):
+    """Like ``quantize_weight`` but the scale KEEPS the reduced axes
+    (size-1 dims), so ``int8 * scale`` broadcasts back to the original
+    shape with no layer-specific reshape — the serving engine's generic
+    dequantize-in-trace form for arbitrary Layer parameters."""
+    a = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(a), axis=reduce_axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(a / scale), -127, 127).astype(jnp.int8)
+    return {'int8': q, 'scale': scale.astype(jnp.float32)}
+
+
+def dequantize_param(w, dtype):
+    """Inverse of ``quantize_param``: broadcast-multiply back to ``dtype``.
+    Traced inside a served program, XLA fuses the convert-multiply into the
+    consumer's operand read — HBM streams the int8 bytes."""
+    return (w['int8'].astype(jnp.float32) * w['scale']).astype(dtype)
 
 
 def wo_matmul(y, w, cdt):

@@ -16,11 +16,27 @@ from ..nn import quant as _q
 from ..nn.layer_base import Layer
 
 __all__ = ['ImperativeQuantAware', 'PostTrainingQuantization',
-           'quant_post_dynamic', 'weight_only_quantize', 'WeightOnlyLinear',
-           'WeightOnlyConv2D']
+           'quant_post_dynamic', 'quantize_weights', 'weight_only_quantize',
+           'convert_calibrated', 'WeightOnlyLinear', 'WeightOnlyConv2D',
+           'WeightOnlyEmbedding', 'fp8']
 
-from ..nn.quant import (WeightOnlyConv2D, WeightOnlyLinear,  # noqa: E402
+from ..nn.quant import (WeightOnlyConv2D, WeightOnlyEmbedding,  # noqa: E402
+                        WeightOnlyLinear, convert_calibrated,
                         weight_only_quantize)
+from . import fp8  # noqa: E402  (fp8 training numerics — quantization/fp8.py)
+
+
+def quantize_weights(layer):
+    """Weight-only int8 snapshot of ANY Layer for serving: swap every
+    Linear / Conv2D / Embedding sublayer for its int8 form in place
+    (per-output-channel scales; per-row for embeddings) and return the
+    layer. The generalization of the GPT-only ``enable_int8_decode``
+    snapshot — ``InferenceEngine(precision='int8_wo')`` applies the same
+    numerics without mutating the user's layer."""
+    from ..nn.layer_common import Embedding, Linear
+    from ..nn.layer_conv import Conv2D
+    return weight_only_quantize(layer,
+                                layer_types=(Linear, Conv2D, Embedding))
 
 
 class ImperativeQuantAware:
@@ -102,8 +118,9 @@ def quant_post_dynamic(model, sample_inputs=None, batch_nums=8,
     Calibration-based (reference: slim PostTrainingQuantization, redesigned
     for the dygraph/TPU stack): wraps quantizable layers in OBSERVE mode,
     feeds ``sample_inputs`` (an iterable of model inputs) to collect
-    moving-average activation scales, then flips the wrappers to quantized
-    eval. Returns the model.
+    moving-average activation scales, then converts the wrappers into real
+    weight-only int8 layers carrying the calibrated activation scales
+    (``convert_calibrated``). Returns the model.
     """
     _q.quantize_model(model, weight_bits, activation_bits,
                       weight_quantize_type=weight_quantize_type,
@@ -136,11 +153,10 @@ def quant_post_dynamic(model, sample_inputs=None, batch_nums=8,
             'activation scales would stay at 0 and quantized outputs would '
             'collapse to ~0. Pass sample_inputs (an iterable of model input '
             'batches).')
-    # calibration done: flip observers into quantizing mode
-    for sub in model.sublayers(include_self=True):
-        if isinstance(sub, _q._QuantWrapperBase):
-            sub._observe_only = False
-    return model
+    # calibration done: convert the observed wrappers into REAL weight-only
+    # int8 layers (int8 weights + calibrated activation scales) — the model
+    # now serves int8, it doesn't merely simulate it
+    return _q.convert_calibrated(model)
 
 
 class PostTrainingQuantization:

@@ -1,0 +1,204 @@
+"""fp8 matmul numerics with per-tensor delayed scaling.
+
+Reference capability: paddle.amp's O-level mixed precision extended one
+precision tier down — e4m3 forward operands, e5m2 gradients — the float8
+recipe of arxiv 2209.05433 (FP8 formats for deep learning) expressed as a
+TPU/XLA-native primitive contract (arxiv 2104.05755's framing).
+
+Design: quantize-dequantize (qdq) around a normal-dtype matmul rather than
+a native fp8 dot. The qdq simulates fp8 numerics exactly (values are
+rounded to representable fp8 points, out-of-range magnitudes saturate to
+the format max), runs on every backend including the CPU test rig, and on
+TPU XLA pattern-matches the convert-dot-convert sandwich onto the native
+fp8 MXU path where the hardware has one. Scales follow DELAYED scaling: an
+amax history ring (``HISTORY_LEN`` most recent absolute maxima) per tensor
+role, with ``scale = max(history) / format_max`` — the scale applied at
+step N is computed from steps < N, so the step stays a single fused XLA
+program with no data-dependent host decision.
+
+State threading: ``in_qdq`` / ``out_qdq`` are ``custom_vjp`` functions
+whose *cotangents for the scale/history operands are the UPDATED
+scale/history values*. Differentiating a loss with
+``jax.value_and_grad(loss, argnums=(0, 1))`` over ``(params, fp8_state)``
+therefore returns ``(grads, new_fp8_state)`` in one backward pass: the
+state update rides autodiff instead of a side channel, which keeps the
+train step functional, donation-compatible, and free of host syncs.
+``found_inf`` gives GradScaler a device-side overflow predicate over the
+same state (the freshest amax entries), so skip-step logic never forces an
+early device->host readback inside the async executor's lazy-loss window.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['E4M3', 'E5M2', 'E4M3_MAX', 'E5M2_MAX', 'HISTORY_LEN',
+           'available', 'compute_scale', 'update_history',
+           'quantize_dequantize', 'qdq_dynamic', 'in_qdq', 'out_qdq',
+           'fp8_matmul', 'init_meta', 'init_matmul_meta', 'found_inf']
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+HISTORY_LEN = 16
+
+_FMT_MAX = {}
+
+
+def dtype_max(q_dtype):
+    """Largest finite magnitude of an fp8 format."""
+    key = jnp.dtype(q_dtype)
+    if key not in _FMT_MAX:
+        _FMT_MAX[key] = float(jnp.finfo(q_dtype).max)
+    return _FMT_MAX[key]
+
+
+_available = None
+
+
+def available():
+    """True when this jax build carries the float8 dtypes and can run a
+    dot over qdq'd operands (probed once per process)."""
+    global _available
+    if _available is None:
+        try:
+            x = jnp.ones((2, 2), jnp.float32)
+            jnp.matmul(x.astype(E4M3).astype(jnp.float32), x).block_until_ready()
+            _available = True
+        except Exception:
+            _available = False
+    return _available
+
+
+def compute_scale(amax_history, q_dtype):
+    """Delayed-scaling divisor from an amax history ring: the largest
+    recent amax mapped to the format max (floored so a cold all-zero
+    history degrades to scale=1, not a divide-by-zero)."""
+    amax = jnp.max(amax_history)
+    return jnp.where(amax > 0.0, amax / dtype_max(q_dtype),
+                     jnp.float32(1.0)).astype(jnp.float32)
+
+
+def update_history(amax_history, x):
+    """Ring-push ``amax(|x|)`` into slot 0 (oldest entry falls off)."""
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    return jnp.roll(amax_history, 1).at[0].set(amax)
+
+
+def quantize_dequantize(x, q_dtype, scale):
+    """Round-trip ``x`` through ``q_dtype`` with divisor ``scale``:
+    saturates |x/scale| at the format max, rounds to the fp8 grid, scales
+    back. Output keeps ``x``'s dtype; internals run f32."""
+    m = dtype_max(q_dtype)
+    scaled = x.astype(jnp.float32) / scale
+    q = jnp.clip(scaled, -m, m).astype(q_dtype)
+    return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def qdq_dynamic(x, q_dtype=E4M3):
+    """Current-scaling qdq (scale from THIS tensor's amax) — the eager
+    ``amp.auto_cast(dtype='float8')`` path, where there is no carried
+    state to delay against."""
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.where(amax > 0.0, amax / dtype_max(q_dtype),
+                      jnp.float32(1.0))
+    return quantize_dequantize(x, q_dtype, scale)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp pair: state updates ride the cotangents
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def in_qdq(q_dtype, x, scale, amax_history):
+    """Quantize-dequantize a forward operand (x or w) in ``q_dtype`` with
+    the DELAYED scale. Backward: the operand's cotangent passes through
+    untouched; the scale/history "cotangents" are their updated values
+    (see module docstring)."""
+    return quantize_dequantize(x, q_dtype, scale)
+
+
+def _in_qdq_fwd(q_dtype, x, scale, amax_history):
+    qx = quantize_dequantize(x, q_dtype, scale)
+    new_hist = update_history(amax_history, x)
+    new_scale = compute_scale(new_hist, q_dtype)
+    return qx, (new_scale, new_hist)
+
+
+def _in_qdq_bwd(q_dtype, res, g):
+    new_scale, new_hist = res
+    return g, new_scale, new_hist
+
+
+in_qdq.defvjp(_in_qdq_fwd, _in_qdq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def out_qdq(q_dtype, out, scale, amax_history):
+    """Identity forward; the BACKWARD cotangent is qdq'd in ``q_dtype``
+    (e5m2 — gradients need range over precision) with the delayed scale,
+    and the scale/history "cotangents" carry the state observed from the
+    gradient itself."""
+    return out
+
+
+def _out_qdq_fwd(q_dtype, out, scale, amax_history):
+    return out, (scale, amax_history)
+
+
+def _out_qdq_bwd(q_dtype, res, g):
+    scale, amax_history = res
+    qg = quantize_dequantize(g, q_dtype, scale)
+    new_hist = update_history(amax_history, g)
+    new_scale = compute_scale(new_hist, q_dtype)
+    return qg, new_scale, new_hist
+
+
+out_qdq.defvjp(_out_qdq_fwd, _out_qdq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# the matmul primitive + its scaling state
+# ---------------------------------------------------------------------------
+
+def init_meta(layers=None, history_len=HISTORY_LEN):
+    """One tensor role's scaling state: ``{'scale', 'ahist'}`` (f32).
+    ``layers`` stacks a leading dim so per-layer metas ride a lax.scan
+    next to stacked block params."""
+    lead = () if layers is None else (int(layers),)
+    return {'scale': jnp.ones(lead, jnp.float32),
+            'ahist': jnp.zeros(lead + (history_len,), jnp.float32)}
+
+
+def init_matmul_meta(layers=None, history_len=HISTORY_LEN):
+    """Scaling state for one matmul: operand roles 'x' (activation, e4m3),
+    'w' (weight, e4m3) and 'g' (output gradient, e5m2)."""
+    return {r: init_meta(layers, history_len) for r in ('x', 'w', 'g')}
+
+
+def fp8_matmul(x, w, meta):
+    """``x @ w`` with e4m3 forward operands and an e5m2 gradient, per-tensor
+    delayed scaling from ``meta`` (``init_matmul_meta``). Differentiating
+    w.r.t. ``meta`` yields the updated state (the delayed-scaling recursion),
+    NOT a mathematical gradient — thread it with
+    ``jax.value_and_grad(loss, argnums=(0, <meta argnum>))``."""
+    qx = in_qdq(E4M3, x, meta['x']['scale'], meta['x']['ahist'])
+    qw = in_qdq(E4M3, w, meta['w']['scale'], meta['w']['ahist'])
+    out = jnp.matmul(qx, qw)
+    return out_qdq(E5M2, out, meta['g']['scale'], meta['g']['ahist'])
+
+
+def found_inf(state):
+    """Device-side bool: any non-finite amax anywhere in an fp8 state tree
+    (a forward/backward overflow lands in the freshest history slot).
+    No host sync happens here — the caller decides when (whether) to read
+    the scalar back, so GradScaler interop adds nothing to the async
+    executor's lazy-loss window. (No host constants either: the reduction
+    starts from the first leaf, so this runs under a disallow
+    transfer-guard.)"""
+    leaves = jax.tree_util.tree_leaves(state)
+    if not leaves:
+        return jnp.zeros((), jnp.bool_)
+    flags = [~jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    return functools.reduce(jnp.logical_or, flags)

@@ -40,6 +40,28 @@ ENV_MAX_BATCH = 'PADDLE_TPU_SERVE_MAX_BATCH'
 ENV_MAX_DELAY = 'PADDLE_TPU_SERVE_MAX_DELAY_MS'
 
 _LOW_DTYPES = {'bfloat16': jnp.bfloat16, 'float16': jnp.float16}
+# int8_wo: weights stored int8 (per-output-channel scales), dequantized
+# in-trace inside each bucket's executable — activations stay full width
+_PRECISIONS = ('float32', 'bfloat16', 'float16', 'int8_wo')
+
+
+def _wo_param_axes(layer):
+    """Dotted param name -> reduction axes for every parameter with a
+    weight-only int8 layout: Linear [in, out] per-output-channel, Conv2D
+    [out, in, kh, kw] per-filter, Embedding [V, H] per-row. Anything not
+    listed here (biases, norms, exotic layers) stays full precision."""
+    from ..nn.layer_common import Embedding, Linear
+    from ..nn.layer_conv import Conv2D
+    axes = {}
+    for prefix, sub in layer.named_sublayers(include_self=True):
+        name = f'{prefix}.weight' if prefix else 'weight'
+        if isinstance(sub, Linear):
+            axes[name] = (0,)
+        elif isinstance(sub, Conv2D):
+            axes[name] = (1, 2, 3)
+        elif isinstance(sub, Embedding):
+            axes[name] = (1,)
+    return axes
 
 
 def _env_int(name, default):
@@ -121,11 +143,26 @@ class InferenceEngine:
             _warmup_mod.ensure_persistent_cache()
         layer, params, buffers, precision, example_spec = \
             _resolve_backend(net, precision)
+        if precision not in _PRECISIONS:
+            raise ValueError(f'precision must be one of {_PRECISIONS}, '
+                             f'got {precision!r}')
         layer.eval()    # serving is per-sample: BN/dropout must be frozen
         self._layer = layer
         self._precision = precision
         low = _LOW_DTYPES.get(precision)
         self._low = low
+        self._wo_dtypes = {}    # quantized param name -> original dtype
+        if precision == 'int8_wo':
+            from ..ops.weight_only import quantize_param
+            axes = _wo_param_axes(layer)
+            qp = {}
+            for k, v in params.items():
+                if k in axes and jnp.issubdtype(v.dtype, jnp.floating):
+                    qp[k] = quantize_param(v, axes[k])
+                    self._wo_dtypes[k] = v.dtype
+                else:
+                    qp[k] = v
+            params = qp
 
         def lower(tree):
             if low is None:
@@ -174,6 +211,7 @@ class InferenceEngine:
         the weights."""
         from ..nn.layer_base import functional_call
         layer, low = self._layer, self._low
+        wo_dtypes = self._wo_dtypes
 
         def infer(params, buffers, *xs):
             self._trace_count += 1
@@ -181,6 +219,14 @@ class InferenceEngine:
                 xs = [x.astype(low)
                       if jnp.issubdtype(x.dtype, jnp.floating) else x
                       for x in xs]
+            if wo_dtypes:
+                # int8_wo: weights live in HBM as int8; the dequant traces
+                # INTO the executable so XLA fuses convert*scale into the
+                # consumers' operand reads (bytes moved stay int8-sized)
+                from ..ops.weight_only import dequantize_param
+                params = dict(params)
+                for k, dt in wo_dtypes.items():
+                    params[k] = dequantize_param(params[k], dt)
             out, _ = functional_call(layer, params, buffers, *xs)
             return out
         wm = sys.modules.get('paddle_tpu.warmup.manifest')
@@ -379,12 +425,14 @@ class InferenceEngine:
             _obs.histogram('serve.bucket_exec_ms', blbl).observe(1e3 * exec_s)
             # steady-state wall time only — a compile-inclusive first exec
             # would poison the live MFU join
-            _obs.perf.note_step(perf_label, exec_s)
+            _obs.perf.note_step(perf_label, exec_s,
+                                precision=self._precision)
         if _obs.enabled() and _obs.perf.analyzed(perf_label) is None:
             # cache hit on the executable: publishes perf.flops{fn}/
             # perf.hbm_bytes{fn,kind}/intensity for this bucket
             _obs.perf.analyze(perf_label, fn_holder['fn'],
-                              (self._params, self._buffers, *padded))
+                              (self._params, self._buffers, *padded),
+                              precision=self._precision)
         _obs.counter('serve.bucket_rows', blbl).inc(rows)
         _obs.counter('serve.bucket_padded_rows', blbl).inc(bucket)
         done_t = self._clock()

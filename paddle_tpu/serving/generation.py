@@ -208,14 +208,30 @@ class GenerationEngine:
                  num_pages=None, prefill_width=None, temperature=0.0,
                  top_k=None, top_p=None, eos_id=None, queue_capacity=64,
                  default_deadline_ms=None, breaker=None, autostart=True,
-                 forward_fn=None, clock=None):
+                 forward_fn=None, clock=None, precision=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
+        if precision not in (None, 'float32', 'int8_wo'):
+            raise ValueError(
+                f"GenerationEngine precision must be None/'float32'/"
+                f"'int8_wo', got {precision!r}")
         params, cfg, fwd = _resolve_generation_model(net, config, forward_fn)
+        if precision == 'int8_wo':
+            from ..ops.weight_only import is_weight_only
+            if not is_weight_only(params.get('wte')):
+                # family-matched snapshot (qkv/proj/mlp/wte int8, per-output-
+                # channel scales); a model already snapshot (e.g. via
+                # enable_int8_decode) passes through untouched
+                if 'moe' in type(cfg).__name__.lower():
+                    from ..models import moe_gpt as _fam
+                else:
+                    _fam = _gpt
+                params = _fam.quantize_decode_params(params)
         self._params = params
         self.config = cfg
         self._forward_fn = fwd
+        self._precision = precision or 'float32'
 
         s_max = int(cfg.max_seq_len)
         self.max_seq_len = s_max
@@ -761,6 +777,7 @@ class GenerationEngine:
             'ttft_ms_p50': pct(self._h['ttft'], 50),
             'ttft_ms_p99': pct(self._h['ttft'], 99),
             'circuit_state': self._breaker.state,
+            'precision': self._precision,
             'uptime_s': round(elapsed, 3),
         })
         return out

@@ -106,7 +106,7 @@ def _prebuild_train(model, entry):
         raise RuntimeError('prepare(optimizer, loss) must run before '
                            'train-step warmup')
     model._enter_mode(True)
-    mode_key = model._mode_sig()
+    mode_key = (model._mode_sig(), model._amp_sig())
     fns = model._train_steps.get(mode_key)
     if fns is None:
         model._asp_sig = model._asp_signature()
@@ -140,7 +140,7 @@ def _prebuild_eval(model, entry):
     model._enter_mode(False)
     in_sig = _sig_from_json(entry.get('inputs') or [])
     lab_sig = _sig_from_json(entry.get('labels') or [])
-    cache_key = (model._mode_sig(), in_sig, lab_sig)
+    cache_key = (model._mode_sig(), model._amp_sig(), in_sig, lab_sig)
     step = model._eval_steps.get(cache_key)
     if step is None:
         step = model._build_eval_step()

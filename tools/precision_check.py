@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""CPU-safe low-precision gate: fp8 training parity, int8 weight-only
+serving parity, and bytes-moved accounting — ONE json line, nonzero exit
+on any tolerance breach.
+
+Checks (each a pass/fail field in the json):
+
+  - ``fp8_parity``: a tiny-GPT fp8 (e4m3/e5m2 delayed-scaling) train step
+    tracks the full-width loss curve over ``--steps`` steps within
+    ``--fp8-atol`` (the documented tolerance of
+    tests/test_precision.py::test_gpt_fp8_training_matches_full_width).
+  - ``int8wo_parity``: ``InferenceEngine(precision='int8_wo')`` output
+    matches the f32 engine across ragged batch sizes within
+    ``--int8-rel``, with compile count <= ceil(log2(max_batch)) + 1.
+  - ``bytes_moved``: the int8 weight tree is >= ``--bytes-factor`` x
+    smaller than its f32 source (per-output-channel scales included) — the
+    HBM-bandwidth claim behind weight-only serving.
+
+Usage: python tools/precision_check.py [--steps N] [--fp8-atol A]
+       [--int8-rel R] [--bytes-factor F]
+"""
+import argparse
+import json
+import math
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _fp8_parity(steps, atol):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+
+    def curve(precision):
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=32, dtype='float32',
+                            use_flash=False, remat=False,
+                            matmul_precision=precision)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+        opt_state = opt.functional_init(params)
+        step = gpt.make_train_step(cfg, opt)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        losses = []
+        f8 = gpt.init_fp8_state(cfg) if precision == 'fp8' else None
+        for i in range(steps):
+            args = (params, opt_state) + (() if f8 is None else (f8,)) + \
+                (jax.random.PRNGKey(100 + i), jnp.asarray(1e-3), toks, toks)
+            out = step(*args)
+            if f8 is None:
+                loss, params, opt_state = out
+            else:
+                loss, params, opt_state, f8 = out
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    base = curve('none')
+    fp8c = curve('fp8')
+    div = float(np.abs(base - fp8c).max())
+    return {'fp8_loss_divergence': round(div, 6),
+            'fp8_parity': div <= atol}
+
+
+def _int8wo_parity(rel_tol):
+    from paddle_tpu import nn
+    from paddle_tpu.serving.engine import InferenceEngine
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 8)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    rng = np.random.RandomState(0)
+    max_batch = 8
+    e32 = InferenceEngine(net, max_batch_size=max_batch, autostart=False)
+    e8 = InferenceEngine(net, max_batch_size=max_batch,
+                         precision='int8_wo', autostart=False)
+    e32.start()
+    e8.start()
+    try:
+        worst = 0.0
+        for n in (1, 3, 5, 8, 2, 7):
+            x = rng.randn(n, 16).astype('float32')
+            a = e32.submit(x).result(timeout=120)
+            b = e8.submit(x).result(timeout=120)
+            worst = max(worst, float(np.abs(a - b).max()
+                                     / (np.abs(a).max() + 1e-9)))
+        compiles = e8.stats()['compiles']
+        bound = math.ceil(math.log2(max_batch)) + 1
+        return {'int8wo_rel_err': round(worst, 6),
+                'int8wo_compiles': compiles,
+                'int8wo_compile_bound': bound,
+                'int8wo_parity': worst <= rel_tol and compiles <= bound}
+    finally:
+        e32.shutdown(drain=False)
+        e8.shutdown(drain=False)
+
+
+def _bytes_moved(factor):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dtype='float32',
+                        use_flash=False, remat=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = gpt.quantize_decode_params(params)
+
+    def tree_bytes(tree):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(tree)
+                   if hasattr(leaf, 'dtype'))
+
+    f32 = tree_bytes(params)
+    int8 = tree_bytes(qparams)
+    reduction = f32 / max(int8, 1)
+    return {'weight_bytes_f32': f32,
+            'weight_bytes_int8': int8,
+            'bytes_reduction': round(reduction, 3),
+            'bytes_moved': reduction >= factor}
+
+
+def run_gate(steps=6, fp8_atol=5e-3, int8_rel=0.05, bytes_factor=3.0):
+    """All three checks as one dict (importable — bench.py banks this
+    verdict as ``precision_check_ok`` without caring about exit codes)."""
+    out = {'steps': steps}
+    out.update(_fp8_parity(steps, fp8_atol))
+    out.update(_int8wo_parity(int8_rel))
+    out.update(_bytes_moved(bytes_factor))
+    out['ok'] = bool(out['fp8_parity'] and out['int8wo_parity']
+                     and out['bytes_moved'])
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=6)
+    ap.add_argument('--fp8-atol', type=float, default=5e-3)
+    ap.add_argument('--int8-rel', type=float, default=0.05)
+    ap.add_argument('--bytes-factor', type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    out = run_gate(steps=args.steps, fp8_atol=args.fp8_atol,
+                   int8_rel=args.int8_rel, bytes_factor=args.bytes_factor)
+    print(json.dumps(out))
+    return 0 if out['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
