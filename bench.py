@@ -617,6 +617,60 @@ def _child_obs_overhead():
                       'obs_enabled': obs.enabled()}))
 
 
+def _child_telemetry():
+    """Telemetry-plane gate row: tools/telemetry_check.py in a fresh
+    subprocess — an engine with telemetry_port=0 must serve all five
+    endpoints to a real HTTP client, flip /readyz false→true across
+    warmup, and surface a submitted request ID in /debug/requests. The
+    parent banks the verdict as telemetry_check_ok."""
+    _arm_watchdog(PREDICTOR_TIMEOUT_S)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import telemetry_check
+    print(json.dumps(telemetry_check.run_check()))
+
+
+def _child_reqtrace_overhead():
+    """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
+    GenerationEngine with the telemetry plane attached, run by the parent
+    twice (PADDLE_TPU_OBS=0 and =1) so the <5% budget of the per-request
+    flight-recorder + HTTP server path is tracked in BENCH_*.json. Same
+    A/B harness as _child_obs_overhead: a tiny model keeps device compute
+    negligible, so the measurement is dominated by exactly the scheduler
+    host code reqtrace instruments."""
+    _arm_watchdog(300)
+    _force_cpu_if_requested()
+    import jax
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationEngine
+
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=128, dtype='float32',
+                        use_flash=False, remat=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    eng = GenerationEngine(params, cfg, num_slots=4, prefill_width=16,
+                           queue_capacity=128, telemetry_port=0)
+    eng.warmup()
+    prompts = [[(7 * i + j) % 256 for j in range(1 + i % 8)]
+               for i in range(16)]
+    for f in [eng.submit(p, max_new_tokens=16) for p in prompts]:
+        f.result(timeout=300)               # warm both executables
+    # median of several full waves: one wave per sample so a host load
+    # spike skews one sample, not the banked number
+    rates = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=32) for p in prompts]
+        toks = sum(len(f.result(timeout=300)) for f in futs)
+        rates.append(toks / (time.perf_counter() - t0))
+    rates.sort()
+    eng.shutdown()
+    print(json.dumps({'decode_tokens_per_sec': rates[len(rates) // 2],
+                      'obs_enabled': obs.enabled()}))
+
+
 def _child_dp2():
     """2-device dp-mesh rung (always a CPU-mesh child — the parent forces
     --xla_force_host_platform_device_count=2 so it runs on any host):
@@ -1100,6 +1154,33 @@ def main(fast=False):
             out['obs_overhead_pct'] = round(100.0 * (off - on) / off, 2) \
                 if off > 0 else 0.0
 
+        # telemetry plane gate: all five endpoints over real HTTP, the
+        # /readyz warmup flip, and request-ID findability (fresh process)
+        tc, tcnote = _run_child(['--child-telemetry'], PREDICTOR_TIMEOUT_S)
+        if tc is not None:
+            out['telemetry_check_ok'] = bool(tc.get('ok'))
+        else:
+            print(f'telemetry check failed: {tcnote}', file=sys.stderr)
+
+        # request-tracing overhead A/B on the decode rung: flight recorder
+        # + telemetry server enabled vs hard-disabled; budget is <5%
+        rt_res = {}
+        for flag in ('0', '1'):
+            r, rnote = _run_child(
+                ['--child-reqtrace-overhead'], 360,
+                env={'PADDLE_TPU_OBS': flag, 'BENCH_CHILD_TIMEOUT': '360'})
+            if r is None:
+                print(f'reqtrace overhead (PADDLE_TPU_OBS={flag}) failed: '
+                      f'{rnote}', file=sys.stderr)
+                break
+            rt_res[flag] = r['decode_tokens_per_sec']
+        if len(rt_res) == 2:
+            off, on = rt_res['0'], rt_res['1']
+            out['reqtrace_decode_tokens_per_sec_off'] = round(off, 2)
+            out['reqtrace_decode_tokens_per_sec_on'] = round(on, 2)
+            out['reqtrace_overhead_pct'] = round(
+                100.0 * (off - on) / off, 2) if off > 0 else 0.0
+
     if platform != 'cpu':
         dec, dnote = _run_child(['--child-decode'], CONFIG_TIMEOUT_S)
         if dec is not None:
@@ -1208,6 +1289,10 @@ if __name__ == '__main__':
         _child_precision_check()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-obs-overhead':
         _child_obs_overhead()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-telemetry':
+        _child_telemetry()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
+        _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':
         _child_dp2()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':

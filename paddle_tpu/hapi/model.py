@@ -621,9 +621,14 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, resume=None,
-            warmup=None):
+            warmup=None, telemetry_port=None):
         from .callbacks import (AutoResume, CallbackList, ModelCheckpoint,
                                 ProgBarLogger)
+        if telemetry_port is not None:
+            # fit-time telemetry opt-in: serve /metrics (+ /healthz,
+            # /debug/trace) for this training run; lives until process exit
+            # (daemon thread), reachable at self.telemetry.url
+            self.telemetry = _obs.serve_telemetry(port=telemetry_port)
         if warmup is not None:
             # compile the recorded step signatures before the first batch so
             # step 0 runs at steady-state latency (and hits the persistent

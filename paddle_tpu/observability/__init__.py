@@ -13,6 +13,8 @@ through it under a shared namespace:
 - ``data.*``  — DataLoader batches, host collation, device prefetch
 - ``perf.*``  — XLA cost/memory analysis, MFU/roofline, HBM tracking
 - ``slo.*``   — SLO watcher breach counters and firing gauges
+- ``request.*`` — request-scoped flight recorder (started/completed/active)
+- ``server.*``  — telemetry HTTP plane request counters
 
 Quick start::
 
@@ -39,8 +41,15 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                        NULL_METRIC, counter, enabled, find, fmt_key, gauge,
                        histogram, percentile, registry, set_enabled,
                        snapshot, to_prometheus)
-from .trace import (NULL_SPAN, Span, dump_trace, record_event,  # noqa: F401
-                    reset_trace, span, trace_events)
+from .trace import (NULL_SPAN, Span, build_trace_doc, dump_trace,  # noqa: F401
+                    record_event, reset_trace, set_trace_cap, span,
+                    trace_cap, trace_events)
+from .reqtrace import (NULL_RECORD, FlightRecorder,  # noqa: F401
+                       RequestRecord, recorder, reset_requests,
+                       start_request)
+from .server import (NULL_SERVER, TelemetryServer,  # noqa: F401
+                     add_readiness, readiness, remove_readiness,
+                     serve_telemetry, servers, shutdown_telemetry)
 from . import perf  # noqa: F401  (perf.analyze / note_step / sweep_hbm)
 from . import slo   # noqa: F401  (slo.Watcher / slo.watcher())
 
@@ -51,17 +60,23 @@ __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'Span',
     'counter', 'gauge', 'histogram', 'registry', 'span', 'record_event',
     'snapshot', 'to_prometheus', 'trace_events', 'dump_trace', 'dump',
+    'build_trace_doc', 'set_trace_cap', 'trace_cap',
     'enabled', 'set_enabled', 'reset', 'percentile', 'find',
+    'start_request', 'recorder', 'reset_requests',
+    'serve_telemetry', 'servers', 'shutdown_telemetry', 'TelemetryServer',
+    'add_readiness', 'remove_readiness', 'readiness',
     'perf', 'slo',
 ]
 
 
 def reset():
-    """Clear the default registry, the trace ring, AND the perf roofline
-    records (tests, run restarts). Metric objects already held by views
-    keep working but are no longer exported until re-created."""
+    """Clear the default registry, the trace ring, the request flight
+    recorder, AND the perf roofline records (tests, run restarts). Metric
+    objects already held by views keep working but are no longer exported
+    until re-created."""
     registry().reset()
     reset_trace()
+    reset_requests()
     perf.reset_perf()
 
 

@@ -25,9 +25,14 @@ no-ops — no thread, no registry families.
 """
 import threading
 import time
+import weakref
 
 from .registry import cfg, fmt_key, percentile, registry as _registry
 from .trace import record_event
+
+# Every live Watcher registers here (weakly — no lifetime coupling) so the
+# telemetry server's /debug/slo can enumerate rule states process-wide.
+_watchers = weakref.WeakSet()
 
 _CMPS = {
     '>': lambda v, t: v > t,
@@ -105,6 +110,7 @@ class Watcher:
         self._lock = threading.Lock()
         self._thread = None
         self._stop = threading.Event()
+        _watchers.add(self)
 
     def rule(self, name, series, threshold, **kwargs):
         """Create, register, and return a :class:`Rule`."""
@@ -236,6 +242,27 @@ class _NullWatcher:
 
 
 NULL_WATCHER = _NullWatcher()
+
+
+def rule_states():
+    """Every rule of every live Watcher as a JSON-able list (the
+    ``/debug/slo`` payload): name, ok/firing state, last value, and the
+    rule's full description. Empty when disabled or no watchers exist."""
+    if not cfg.enabled:
+        return []
+    out = []
+    for w in list(_watchers):
+        polling = w._thread is not None and w._thread.is_alive()
+        for r in w.rules:
+            out.append({'rule': r.name, 'state': r.state,
+                        'series': fmt_key(r.series, r.labels),
+                        'stat': r.stat, 'cmp': r.cmp,
+                        'threshold': r.threshold,
+                        'last_value': r.last_value,
+                        'debounce': r.debounce,
+                        'polling': polling,
+                        'describe': r.describe()})
+    return sorted(out, key=lambda d: d['rule'])
 
 
 def watcher(interval=1.0):

@@ -27,6 +27,23 @@ TRACE_CAP = int(os.environ.get('PADDLE_TPU_OBS_TRACE_CAP', '100000'))
 
 _lock = threading.Lock()
 _events = collections.deque(maxlen=TRACE_CAP)
+
+
+def set_trace_cap(n):
+    """Re-bound the span ring at runtime (tests, the ``/debug/trace``
+    endpoint). The env knob only sets the import-time default; this swaps
+    the ring for one of the new capacity, keeping the newest events.
+    Returns the new cap."""
+    global TRACE_CAP, _events
+    n = max(1, int(n))
+    with _lock:
+        TRACE_CAP = n
+        _events = collections.deque(_events, maxlen=n)
+    return n
+
+
+def trace_cap():
+    return TRACE_CAP
 _tid_names = {}          # tid -> thread name at record time (for ph:'M')
 _origin_mono = time.perf_counter()
 _origin_wall = time.time()
@@ -151,10 +168,21 @@ def record_event(name, **attrs):
         _tid_names[tid] = threading.current_thread().name
 
 
-def trace_events():
-    """Copy of the completed-event ring (Chrome trace-event dicts)."""
+def now_us():
+    """Current trace-clock timestamp (µs since the monotonic origin) —
+    the same clock every event's ``ts`` is stamped in."""
+    return _now_us()
+
+
+def trace_events(since_us=None):
+    """Copy of the completed-event ring (Chrome trace-event dicts).
+    ``since_us`` keeps only events whose ``ts`` is at or after that
+    trace-clock timestamp (the ``/debug/trace?ms=N`` capture window)."""
     with _lock:
-        return list(_events)
+        events = list(_events)
+    if since_us is not None:
+        events = [e for e in events if e.get('ts', 0.0) >= since_us]
+    return events
 
 
 def reset_trace():
@@ -163,27 +191,55 @@ def reset_trace():
         _tid_names.clear()
 
 
+def _wall_anchor():
+    """Fresh wall↔monotonic mapping, taken NOW. The import-time pair
+    drifts in long runs (NTP slew, clock steps, VM suspend), so dumped
+    wall timestamps derived from it go stale; re-deriving the origin from
+    a current reading of both clocks keeps ``wall_origin + ts/1e6`` true
+    to real time at dump time. Both clocks (and the measured drift) land
+    in the metadata so consumers can pick either."""
+    mono_now = time.perf_counter()
+    wall_now = time.time()
+    wall_origin = wall_now - (mono_now - _origin_mono)
+    return {'wall_origin': wall_origin,
+            'wall_origin_at_import': _origin_wall,
+            'wall_at_dump': wall_now,
+            'mono_us_at_dump': round((mono_now - _origin_mono) * 1e6, 3),
+            'wall_drift_s': round(wall_origin - _origin_wall, 6),
+            'clock': 'perf_counter_us_since_origin'}
+
+
+def build_trace_doc(events=None):
+    """Chrome-trace document for ``events`` (default: the whole ring),
+    with process/thread-name metadata (``ph:'M'``) and the re-anchored
+    wall-clock mapping in ``otherData``."""
+    with _lock:
+        if events is None:
+            events = list(_events)
+        tid_names = dict(_tid_names)
+    pid = os.getpid()
+    meta = [{'name': 'process_name', 'ph': 'M', 'pid': pid,
+             'args': {'name': 'paddle_tpu'}}]
+    seen_tids = {e['tid'] for e in events if 'tid' in e}
+    for tid, tname in sorted(tid_names.items()):
+        if tid in seen_tids:
+            meta.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
+                         'tid': tid, 'args': {'name': tname}})
+    return {'traceEvents': meta + events,
+            'displayTimeUnit': 'ms',
+            'otherData': _wall_anchor()}
+
+
 def dump_trace(path):
     """Write the span ring as Chrome-trace JSON (loads in chrome://tracing
     and Perfetto). Returns the event count written. Metadata (``ph:'M'``)
     events name the process and every thread that recorded a span, so
     Perfetto lanes read "Thread-dispatch" instead of a bare TID."""
-    with _lock:
-        events = list(_events)
-        tid_names = dict(_tid_names)
-    pid = os.getpid()
-    meta = [{'name': 'process_name', 'ph': 'M', 'pid': pid,
-             'args': {'name': 'paddle_tpu'}}]
-    for tid, tname in sorted(tid_names.items()):
-        meta.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
-                     'tid': tid, 'args': {'name': tname}})
-    doc = {'traceEvents': meta + events,
-           'displayTimeUnit': 'ms',
-           'otherData': {'wall_origin': _origin_wall,
-                         'clock': 'perf_counter_us_since_origin'}}
+    doc = build_trace_doc()
+    n = sum(1 for e in doc['traceEvents'] if e.get('ph') != 'M')
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, 'w') as f:
         json.dump(doc, f, default=str)
-    return len(events)
+    return n

@@ -8,6 +8,7 @@ the lock; every method here must be called with it held.
 import threading
 import numpy as np
 
+from ..observability.reqtrace import NULL_RECORD as _NULL_REC
 from .bucketing import input_signature
 
 
@@ -16,15 +17,19 @@ class Request:
     signature. Oversized submissions are split into several Requests whose
     futures are joined by ``SplitJoin``."""
 
-    __slots__ = ('arrays', 'n', 'sig', 'future', 'enqueue_t', 'deadline_t')
+    __slots__ = ('arrays', 'n', 'sig', 'future', 'enqueue_t', 'deadline_t',
+                 'rec')
 
-    def __init__(self, arrays, sig, future, enqueue_t, deadline_t):
+    def __init__(self, arrays, sig, future, enqueue_t, deadline_t, rec=None):
         self.arrays = arrays
         self.n = arrays[0].shape[0]
         self.sig = sig
         self.future = future
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
+        # request-scoped trace record (observability.reqtrace); a shared
+        # no-op singleton when the layer is disabled
+        self.rec = rec if rec is not None else _NULL_REC
 
 
 class SplitJoin:

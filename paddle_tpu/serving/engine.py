@@ -137,7 +137,7 @@ class InferenceEngine:
     def __init__(self, net=None, *, max_batch_size=None, max_delay_ms=None,
                  queue_capacity=256, precision=None, default_deadline_ms=None,
                  breaker=None, autostart=True, clock=None, warmup=None,
-                 input_spec=None):
+                 input_spec=None, telemetry_port=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -198,6 +198,15 @@ class InferenceEngine:
         self._draining = False
         self._example_spec = input_spec if input_spec is not None \
             else example_spec
+        # readiness + optional telemetry plane: the engine advertises one
+        # /readyz probe (warm AND breaker closed AND queue below capacity);
+        # telemetry_port=N additionally starts the HTTP server (0 = pick a
+        # free port, read it back from engine.telemetry.port)
+        self._warmed = False
+        self._probe_name = f'serving.{self._stats.labels["engine"]}'
+        _obs.add_readiness(self._probe_name, self._readiness_probe)
+        self.telemetry = (_obs.serve_telemetry(port=telemetry_port)
+                          if telemetry_port is not None else _obs.NULL_SERVER)
         if warmup is not None:
             # precompile before submit() is ever accepted: the first real
             # request must find its executable already in the bucket cache
@@ -247,7 +256,25 @@ class InferenceEngine:
         if isinstance(manifest, str) and manifest == 'all_buckets':
             manifest = _warmup_mod.all_buckets_manifest(
                 self, input_spec=input_spec)
-        return _warmup_mod.prebuild(manifest, engine=self)
+        report = _warmup_mod.prebuild(manifest, engine=self)
+        self._warmed = True          # flips the /readyz warm check
+        return report
+
+    # ---- readiness -------------------------------------------------------
+    def _readiness_probe(self):
+        """The engine's /readyz contribution: warm (explicit warmup ran, or
+        traffic has already compiled at least one bucket) AND circuit
+        breaker closed AND queue below capacity AND not shut down."""
+        with self._lock:
+            depth = self._queues.depth
+            closed = self._closed
+        warm = self._warmed or len(self._cache) > 0
+        breaker = self._breaker.state
+        ready = (warm and breaker == 'closed'
+                 and depth < self.queue_capacity and not closed)
+        return {'ready': ready, 'warm': warm, 'breaker': breaker,
+                'queue_depth': depth, 'queue_capacity': self.queue_capacity,
+                'closed': closed}
 
     # ---- lifecycle -------------------------------------------------------
     def start(self):
@@ -277,11 +304,16 @@ class InferenceEngine:
             failed = [] if drain else self._queues.drain_all()
             self._cv.notify_all()
         for r in failed:
-            r.future.set_exception(EngineClosedError('engine shut down'))
+            err = EngineClosedError('engine shut down')
+            r.rec.note('cancel')
+            r.rec.finish('cancelled', err)
+            r.future.set_exception(err)
         if inline:
             self._drain_inline()
         if self._thread is not None:
             self._thread.join(timeout)
+        _obs.remove_readiness(self._probe_name)
+        self.telemetry.stop()
 
     def __enter__(self):
         return self.start()
@@ -299,6 +331,11 @@ class InferenceEngine:
         deadline_t = (now + deadline_ms / 1e3
                       if deadline_ms is not None else None)
         future = Future()
+        # request-scoped trace: one record per submit(), shared by every
+        # chunk of a split request (NULL_RECORD when obs is disabled)
+        rec = _obs.start_request(
+            'serve', engine=self._stats.labels['engine'], rows=n)
+        future.request_id = rec.rid
         max_b = self.max_batch_size
         if n <= max_b:
             chunks = [(arrays, future)]
@@ -309,21 +346,28 @@ class InferenceEngine:
             join = SplitJoin(future, len(bounds) - 1)
             chunks = [([a[lo:hi] for a in arrays], join.part(i))
                       for i, (lo, hi) in enumerate(zip(bounds, bounds[1:]))]
-        with self._cv:
-            if self._closed:
-                raise EngineClosedError('engine already shut down')
-            depth = self._queues.depth
-            if depth + len(chunks) > self.queue_capacity:
-                self._stats.note_rejected()
-                raise QueueFullError(self.queue_capacity, depth)
-            for arrs, fut in chunks:
-                self._queues.push(Request(arrs, sig, fut, now, deadline_t))
-            # split requests are accounted per admitted chunk so submitted/
-            # completed/occupancy all measure the same unit of work
-            self._stats.note_submitted(len(chunks))
-            if len(chunks) > 1:
-                self._stats.note_split()
-            self._cv.notify_all()
+            rec.expect_parts(len(chunks))
+        try:
+            with self._cv:
+                if self._closed:
+                    raise EngineClosedError('engine already shut down')
+                depth = self._queues.depth
+                if depth + len(chunks) > self.queue_capacity:
+                    self._stats.note_rejected()
+                    raise QueueFullError(self.queue_capacity, depth)
+                rec.note('enqueue', depth=depth, chunks=len(chunks))
+                for arrs, fut in chunks:
+                    self._queues.push(
+                        Request(arrs, sig, fut, now, deadline_t, rec=rec))
+                # split requests are accounted per admitted chunk so
+                # submitted/completed/occupancy all measure the same unit
+                self._stats.note_submitted(len(chunks))
+                if len(chunks) > 1:
+                    self._stats.note_split()
+                self._cv.notify_all()
+        except Exception as e:
+            rec.finish('rejected', e)
+            raise
         if self._autostart and self._thread is None:
             self.start()
         return future
@@ -356,6 +400,7 @@ class InferenceEngine:
             self._execute(*group)
         except BaseException as e:     # never kill the dispatch thread
             for r in group[1]:
+                r.rec.finish('error', e)
                 if not _future_done(r.future):
                     r.future.set_exception(e)
             self._stats.note_failed(len(group[1]))
@@ -379,7 +424,10 @@ class InferenceEngine:
             if r.deadline_t is not None and now > r.deadline_t:
                 waited = (now - r.enqueue_t) * 1e3
                 limit = (r.deadline_t - r.enqueue_t) * 1e3
-                r.future.set_exception(DeadlineExceededError(waited, limit))
+                err = DeadlineExceededError(waited, limit)
+                r.rec.note('expire', waited_ms=round(waited, 3))
+                r.rec.finish('expired', err)
+                r.future.set_exception(err)
                 self._stats.note_expired()
             else:
                 live.append(r)
@@ -388,6 +436,8 @@ class InferenceEngine:
             return
         rows = sum(r.n for r in live)
         bucket = bucket_for(rows, self.max_batch_size)
+        for r in live:
+            r.rec.note('admit', bucket=bucket, batch_rows=rows)
         n_in = len(live[0].arrays)
         cols = [np.concatenate([r.arrays[i] for r in live], axis=0)
                 if len(live) > 1 else live[0].arrays[i]
@@ -406,12 +456,16 @@ class InferenceEngine:
             # ONE host readback for the whole batch, then host-side slicing
             return [np.asarray(o) for o in outs]
 
+        span_kw = {'bucket': bucket, 'rows': rows, 'requests': len(live)}
+        if _obs.enabled():
+            # request IDs on the span: follow one request through Perfetto
+            span_kw['req_ids'] = [r.rec.rid for r in live if r.rec.rid]
         try:
-            with _obs.span('serve.batch', bucket=bucket, rows=rows,
-                           requests=len(live)):
+            with _obs.span('serve.batch', **span_kw):
                 outs = self._breaker.call(device_call)
         except Exception as e:
             for r in live:
+                r.rec.finish('error', e)
                 r.future.set_exception(e)
             self._stats.note_failed(len(live))
             return
@@ -443,6 +497,9 @@ class InferenceEngine:
                    for o in outs]
             off += r.n
             r.future.set_result(res[0] if len(res) == 1 else res)
+            r.rec.note('retire', rows=r.n, bucket=bucket)
+            if r.rec.part_retired():
+                r.rec.finish('ok')
             self._stats.note_completed(done_t - r.enqueue_t)
         self._stats.note_batch(rows=rows, bucket=bucket, exec_s=exec_s)
 
@@ -460,6 +517,7 @@ class InferenceEngine:
         out['max_delay_ms'] = self.max_delay_s * 1e3
         out['precision'] = self._precision
         out['circuit_state'] = self._breaker.state
+        out['warmed'] = self._warmed
         return out
 
 

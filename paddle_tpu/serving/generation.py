@@ -140,10 +140,10 @@ class GenerationFuture:
 
 class _Request:
     __slots__ = ('prompt', 'eff_max_new', 'seed', 'future', 'enqueue_t',
-                 'deadline_t', 'evictions', 'ttft_noted')
+                 'deadline_t', 'evictions', 'ttft_noted', 'rec')
 
     def __init__(self, prompt, eff_max_new, seed, future, enqueue_t,
-                 deadline_t):
+                 deadline_t, rec=None):
         self.prompt = prompt
         self.eff_max_new = eff_max_new
         self.seed = seed
@@ -152,6 +152,9 @@ class _Request:
         self.deadline_t = deadline_t
         self.evictions = 0
         self.ttft_noted = False
+        # request-scoped trace record (observability.reqtrace); the shared
+        # no-op singleton when the layer is disabled
+        self.rec = rec if rec is not None else _obs.NULL_RECORD
 
 
 class _Slot:
@@ -208,7 +211,8 @@ class GenerationEngine:
                  num_pages=None, prefill_width=None, temperature=0.0,
                  top_k=None, top_p=None, eos_id=None, queue_capacity=64,
                  default_deadline_ms=None, breaker=None, autostart=True,
-                 forward_fn=None, clock=None, precision=None):
+                 forward_fn=None, clock=None, precision=None,
+                 telemetry_port=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -284,6 +288,27 @@ class GenerationEngine:
                                   'expired', 'failed', 'evictions',
                                   'tokens', 'prefills', 'steps')}
         self._make_metrics()
+        # readiness + optional telemetry plane (same contract as
+        # InferenceEngine: /readyz = warm AND breaker closed AND queue
+        # below capacity; telemetry_port=0 picks a free port)
+        self._warmed = False
+        self._probe_name = f'serving.{self.labels["engine"]}'
+        _obs.add_readiness(self._probe_name, self._readiness_probe)
+        self.telemetry = (_obs.serve_telemetry(port=telemetry_port)
+                          if telemetry_port is not None else _obs.NULL_SERVER)
+
+    def _readiness_probe(self):
+        with self._lock:
+            depth = len(self._queue)
+            closed = self._closed
+        warm = (self._warmed or self._fns is not None
+                or ('gen_prefill' in self._aot and 'gen_decode' in self._aot))
+        breaker = self._breaker.state
+        ready = (warm and breaker == 'closed'
+                 and depth < self.queue_capacity and not closed)
+        return {'ready': ready, 'warm': warm, 'breaker': breaker,
+                'queue_depth': depth, 'queue_capacity': self.queue_capacity,
+                'closed': closed}
 
     # ---- telemetry -------------------------------------------------------
     def _make_metrics(self):
@@ -391,7 +416,9 @@ class GenerationEngine:
         man = _warmup_mod.Manifest()
         for e in self._manifest_entries():
             man.add(e)
-        return _warmup_mod.prebuild(man, generation=self)
+        report = _warmup_mod.prebuild(man, generation=self)
+        self._warmed = True          # flips the /readyz warm check
+        return report
 
     # ---- lifecycle -------------------------------------------------------
     def start(self):
@@ -425,12 +452,17 @@ class GenerationEngine:
             inline = drain and self._thread is None
             self._cv.notify_all()
         for r in failed:
-            if r.future._finish(EngineClosedError('engine shut down')):
+            err = EngineClosedError('engine shut down')
+            r.rec.note('cancel')
+            r.rec.finish('cancelled', err)
+            if r.future._finish(err):
                 self._note('failed')
         if inline:
             self._drain_inline()
         if self._thread is not None:
             self._thread.join(timeout)
+        _obs.remove_readiness(self._probe_name)
+        self.telemetry.stop()
 
     def __enter__(self):
         return self.start()
@@ -463,17 +495,28 @@ class GenerationEngine:
         deadline_t = (now + deadline_ms / 1e3
                       if deadline_ms is not None else None)
         fut = GenerationFuture()
+        # request-scoped trace: minted here, rides the request across the
+        # submit -> scheduler thread boundary (NULL_RECORD when disabled)
+        rec = _obs.start_request('gen', engine=self.labels['engine'],
+                                 prompt_len=t0, max_new=eff)
+        fut.request_id = rec.rid
         req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, now,
-                       deadline_t)
-        with self._cv:
-            if self._closed:
-                raise EngineClosedError('engine already shut down')
-            if len(self._queue) >= self.queue_capacity:
-                self._note('rejected')
-                raise QueueFullError(self.queue_capacity, len(self._queue))
-            self._queue.append(req)
-            self._note('submitted')
-            self._cv.notify_all()
+                       deadline_t, rec=rec)
+        try:
+            with self._cv:
+                if self._closed:
+                    raise EngineClosedError('engine already shut down')
+                if len(self._queue) >= self.queue_capacity:
+                    self._note('rejected')
+                    raise QueueFullError(self.queue_capacity,
+                                         len(self._queue))
+                rec.note('enqueue', depth=len(self._queue))
+                self._queue.append(req)
+                self._note('submitted')
+                self._cv.notify_all()
+        except Exception as e:
+            rec.finish('rejected', e)
+            raise
         if self._autostart and self._thread is None:
             self.start()
         return fut
@@ -524,15 +567,20 @@ class GenerationEngine:
                 self._queue.popleft()
                 waited = (now - req.enqueue_t) * 1e3
                 limit = (req.deadline_t - req.enqueue_t) * 1e3
-                if req.future._finish(DeadlineExceededError(waited, limit)):
+                err = DeadlineExceededError(waited, limit)
+                req.rec.note('expire', waited_ms=round(waited, 3))
+                req.rec.finish('expired', err)
+                if req.future._finish(err):
                     self._note('expired')
                 continue
             need = _pkv.pages_for(len(req.prompt), self.page_size)
             if need > self.num_pages - 1:
                 self._queue.popleft()
-                req.future._finish(ValueError(
+                err = ValueError(
                     f'prompt needs {need} pages but the pool only has '
-                    f'{self.num_pages - 1} allocatable'))
+                    f'{self.num_pages - 1} allocatable')
+                req.rec.finish('error', err)
+                req.future._finish(err)
                 self._note('failed')
                 continue
             pages = self._alloc.alloc(need)
@@ -541,6 +589,7 @@ class GenerationEngine:
             self._queue.popleft()
             table = np.zeros((self.p_max,), np.int32)
             table[:need] = pages
+            req.rec.note('admit', slot=free_idx, pages=need)
             self._slots[free_idx] = _Slot(req, table, self._admit_seq)
             self._admit_seq += 1
             out.append(free_idx)
@@ -570,8 +619,10 @@ class GenerationEngine:
                            jnp.asarray(seed))
             return int(np.asarray(tok)[0]), pool
 
+        req.rec.note('prefill', slot=idx, prompt_len=t0)
         try:
-            with _obs.span('gen.prefill', slot=idx, prompt_len=t0):
+            with _obs.span('gen.prefill', slot=idx, prompt_len=t0,
+                           req_id=req.rec.rid):
                 tok, pool = self._breaker.call(dev)
         except Exception as e:
             self._handle_device_failure(e)
@@ -592,6 +643,7 @@ class GenerationEngine:
         pos = np.zeros((s,), np.int32)
         table = np.zeros((s, self.p_max), np.int32)
         seeds = np.zeros((s,), np.uint32)
+        rids = []
         with self._cv:
             self._ensure_pages_locked()
             active = []
@@ -603,6 +655,8 @@ class GenerationEngine:
                 table[i] = slot.table
                 seeds[i] = slot.req.seed
                 active.append(i)
+                if slot.req.rec.rid:
+                    rids.append(slot.req.rec.rid)
         if not active:
             return
         self._maybe_record()
@@ -618,7 +672,8 @@ class GenerationEngine:
             return np.asarray(nxt), pool
 
         try:
-            with _obs.span('gen.decode_step', slots=len(active)):
+            with _obs.span('gen.decode_step', slots=len(active),
+                           req_ids=rids):
                 nxt, pool = self._breaker.call(dev)
         except Exception as e:
             self._handle_device_failure(e)
@@ -634,6 +689,7 @@ class GenerationEngine:
                 t = int(nxt[i])
                 slot.pos += 1
                 slot.last_tok = t
+                slot.req.rec.note_decode(slot.pos)
                 self._emit_locked(slot, t)
                 if self._slot_finished(slot, t):
                     self._finish_slot_locked(i)
@@ -650,8 +706,9 @@ class GenerationEngine:
             req.future._append(tok)
             if not req.ttft_noted:
                 req.ttft_noted = True
-                self._h['ttft'].observe(
-                    1e3 * (self._clock() - req.enqueue_t))
+                ttft_ms = 1e3 * (self._clock() - req.enqueue_t)
+                self._h['ttft'].observe(ttft_ms)
+                req.rec.note('first_emit', ttft_ms=round(ttft_ms, 3))
 
     def _slot_finished(self, slot, tok):
         if self.eos_id is not None and tok == self.eos_id:
@@ -670,6 +727,9 @@ class GenerationEngine:
     def _finish_slot_locked(self, idx):
         slot = self._slots[idx]
         self._free_slot_locked(idx)
+        slot.req.rec.note('retire', produced=slot.produced,
+                          evictions=slot.req.evictions)
+        slot.req.rec.finish('ok')
         if slot.req.future._finish():
             self._note('completed')
         self._cv.notify_all()
@@ -725,6 +785,7 @@ class GenerationEngine:
         req = slot.req
         self._free_slot_locked(idx)
         req.evictions += 1
+        req.rec.note('evict', count=req.evictions)
         self._note('evictions')
         # FRONT of the queue: an evicted sequence restarts before any new
         # arrival — bounded starvation, deterministic regeneration
@@ -744,6 +805,7 @@ class GenerationEngine:
             self._update_gauges_locked()
             self._cv.notify_all()
         for r in failed:
+            r.rec.finish('error', exc)
             if r.future._finish(exc):
                 self._note('failed')
 
@@ -778,6 +840,7 @@ class GenerationEngine:
             'ttft_ms_p99': pct(self._h['ttft'], 99),
             'circuit_state': self._breaker.state,
             'precision': self._precision,
+            'warmed': self._warmed,
             'uptime_s': round(elapsed, 3),
         })
         return out

@@ -281,6 +281,10 @@ def prebuild(manifest, *, engine=None, model=None, predictor=None,
     finally:
         if model is not None and orig_mode is not None:
             model._enter_mode(orig_mode)
+    # mark the targets warm for the telemetry plane's /readyz probes
+    for target in (engine, generation):
+        if target is not None and hasattr(target, '_warmed'):
+            target._warmed = True
     report['total_ms'] = round(1e3 * (time.perf_counter() - t_start), 3)
     return report
 

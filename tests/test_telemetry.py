@@ -1,0 +1,439 @@
+"""Telemetry plane (ISSUE 10): live HTTP endpoints over real sockets,
+request-scoped tracing, and the supporting ring/clock machinery.
+
+Covers: every endpoint served from a live engine process, the /readyz
+readiness flip around ``engine.warmup()``, a GenerationEngine request's
+full timeline (enqueue → admit → prefill → decode → retire) with its
+request ID stamped on the engine's trace spans, byte-identical Prometheus
+exposition over HTTP (including the PR-6 label-escaping corner), dump_trace
+racing live spans from other threads, flight-recorder retention, runtime
+trace-cap rebounds, the re-anchored wall clock, disabled-mode inertness,
+and ``obs_report --url`` live scraping.
+"""
+import json
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import nn, serving
+from paddle_tpu import observability as obs
+from paddle_tpu.models import gpt
+from paddle_tpu.observability import reqtrace as _reqtrace
+from paddle_tpu.observability import server as _server
+from paddle_tpu.observability import trace as _trace
+from paddle_tpu.serving import GenerationEngine
+
+pytestmark = pytest.mark.telemetry
+
+IN_DIM, OUT_DIM = 16, 4
+
+CFG = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=32, dtype='float32', remat=False,
+                    use_flash=False)
+
+
+@pytest.fixture(scope='module')
+def params():
+    return gpt.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Enabled + empty registry/trace/requests per test; stray servers and
+    readiness probes must not leak across tests."""
+    obs.set_enabled(True)
+    obs.reset()
+    cap0 = obs.trace_cap()
+    with _server._probes_lock:
+        probes0 = dict(_server._probes)
+    yield
+    obs.shutdown_telemetry()
+    with _server._probes_lock:
+        _server._probes.clear()
+        _server._probes.update(probes0)
+    obs.set_trace_cap(cap0)
+    obs.set_enabled(True)
+    obs.reset()
+
+
+def _get(url, timeout=15):
+    """(status, body_bytes, content_type) over a real HTTP client."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read(), r.headers.get('Content-Type', '')
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get('Content-Type', '')
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def _import_tool(name):
+    sys.path.insert(0, 'tools')
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# server basics
+# ---------------------------------------------------------------------------
+
+def test_serve_telemetry_basic_endpoints():
+    srv = obs.serve_telemetry(port=0)
+    assert srv.port > 0 and srv in obs.servers()
+    code, body, _ = _get(srv.url + '/healthz')
+    health = json.loads(body)
+    assert code == 200 and health['status'] == 'alive'
+    assert health['uptime_s'] >= 0
+
+    code, body, ctype = _get(srv.url + '/metrics')
+    assert code == 200
+    assert ctype == _server.PROM_CONTENT_TYPE
+    assert ctype.startswith('text/plain') and 'version=0.0.4' in ctype
+
+    code, body, _ = _get(srv.url + '/nope')
+    err = json.loads(body)
+    assert code == 404 and '/metrics' in err['paths']
+
+    code, body, _ = _get(srv.url + '/debug/slo')
+    assert code == 200 and 'rules' in json.loads(body)
+
+    srv.stop()
+    assert srv not in obs.servers()
+    with pytest.raises(OSError):
+        urllib.request.urlopen(srv.url + '/healthz', timeout=2)
+
+
+def test_metrics_http_byte_identical_with_label_escaping():
+    # the PR-6 escaping corner must survive the HTTP hop byte-for-byte
+    originals = {'path': 'a\\b', 'msg': 'line1\nline2 "quoted"'}
+    obs.gauge('esc.g', originals).set(1.0)
+    obs.counter('serve.requests_submitted', {'engine': 'e9'}).inc(3)
+    srv = obs.serve_telemetry(port=0)
+    _, body, _ = _get(srv.url + '/metrics')
+    srv.stop()
+    text = body.decode('utf-8')
+    assert text == obs.to_prometheus()        # byte-identical exposition
+    sample = [l for l in text.splitlines() if l.startswith('esc_g{')]
+    assert len(sample) == 1                   # newline never splits a sample
+    recovered = {}
+    for k, v in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', sample[0]):
+        recovered[k] = (v.replace('\\n', '\n').replace('\\"', '"')
+                        .replace('\\\\', '\\'))
+    assert recovered == originals
+
+
+def test_readyz_probe_aggregation():
+    srv = obs.serve_telemetry(port=0)
+    # no probes registered -> trivially ready (liveness is the only claim)
+    code, body, _ = _get(srv.url + '/readyz')
+    assert code == 200 and json.loads(body)['ready'] is True
+
+    obs.add_readiness('t.bool', lambda: True)
+    obs.add_readiness('t.dict', lambda: {'ready': False, 'why': 'warming'})
+    code, body, _ = _get(srv.url + '/readyz')
+    doc = json.loads(body)
+    assert code == 503 and doc['ready'] is False
+    assert doc['checks']['t.dict']['why'] == 'warming'
+    assert doc['checks']['t.bool'] == {'ready': True}
+
+    obs.remove_readiness('t.dict')
+
+    def _boom():
+        raise RuntimeError('probe crashed')
+    obs.add_readiness('t.raise', _boom)       # raising probe -> not ready
+    code, body, _ = _get(srv.url + '/readyz')
+    doc = json.loads(body)
+    assert code == 503
+    assert 'RuntimeError' in doc['checks']['t.raise']['error']
+
+    obs.remove_readiness('t.raise')
+    code, _, _ = _get(srv.url + '/readyz')
+    assert code == 200
+    obs.remove_readiness('t.bool')
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# live engines
+# ---------------------------------------------------------------------------
+
+def test_inference_engine_readyz_flip_and_request_timeline():
+    engine = serving.InferenceEngine(nn.Linear(IN_DIM, OUT_DIM),
+                                     max_batch_size=8, max_delay_ms=0.5,
+                                     telemetry_port=0)
+    base = engine.telemetry.url
+    assert base.startswith('http://127.0.0.1:')
+    try:
+        code, body, _ = _get(base + '/readyz')
+        doc = json.loads(body)
+        assert code == 503                    # not warmed yet
+        probe = doc['checks'][f'serving.{engine._stats.labels["engine"]}']
+        assert probe['warm'] is False and probe['breaker'] == 'closed'
+
+        engine.warmup(input_spec=[((IN_DIM,), 'float32')])
+        code, body, _ = _get(base + '/readyz')
+        assert code == 200 and json.loads(body)['ready'] is True
+
+        fut = engine.submit(np.ones((2, IN_DIM), np.float32))
+        fut.result(timeout=120)
+        rid = fut.request_id
+        assert rid.startswith('serve-')
+
+        assert _wait(lambda: (obs.recorder().lookup(rid) or {})
+                     .get('outcome') == 'ok')
+        code, body, _ = _get(base + '/debug/requests?id=' + rid)
+        doc = json.loads(body)
+        assert code == 200 and doc['count'] == 1
+        rec = doc['requests'][0]
+        assert rec['id'] == rid and rec['outcome'] == 'ok'
+        evs = [e['ev'] for e in rec['timeline']]
+        assert evs.index('enqueue') < evs.index('admit') < evs.index('retire')
+
+        code, body, _ = _get(base + '/debug/requests?outcome=ok&limit=5')
+        assert any(r['id'] == rid for r in json.loads(body)['requests'])
+    finally:
+        engine.shutdown()
+    # shutdown tears the plane down: probe gone, socket closed
+    assert f'serving.{engine._stats.labels["engine"]}' not in _server._probes
+    with pytest.raises(OSError):
+        urllib.request.urlopen(base + '/healthz', timeout=2)
+
+
+def test_generation_engine_timeline_and_span_request_ids(params):
+    eng = GenerationEngine(params, CFG, num_slots=2, page_size=8,
+                           prefill_width=16, telemetry_port=0)
+    base = eng.telemetry.url
+    try:
+        code, _, _ = _get(base + '/readyz')
+        assert code == 503                    # nothing compiled yet
+        eng.warmup()
+        code, _, _ = _get(base + '/readyz')
+        assert code == 200
+
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, CFG.vocab_size, size=t).astype(np.int32)
+                   for t in (5, 9)]
+        futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        rids = [f.request_id for f in futs]
+        for f in futs:
+            assert len(f.result(timeout=120)) >= 1
+        assert all(r.startswith('gen-') for r in rids)
+
+        for rid in rids:
+            assert _wait(lambda: (obs.recorder().lookup(rid) or {})
+                         .get('outcome') == 'ok')
+            rec = obs.recorder().lookup(rid)
+            evs = [e['ev'] for e in rec['timeline']]
+            for ev in ('enqueue', 'admit', 'prefill', 'decode', 'retire'):
+                assert ev in evs, (rid, evs)
+            decode = next(e for e in rec['timeline'] if e['ev'] == 'decode')
+            assert decode['steps'] >= 1       # coalesced, not one-per-step
+
+        # the timeline joins the profiler view: rids ride the trace spans
+        events = obs.trace_events()
+        prefills = [e for e in events if e['name'] == 'gen.prefill']
+        steps = [e for e in events if e['name'] == 'gen.decode_step']
+        assert {e['args']['req_id'] for e in prefills} >= set(rids)
+        seen = {r for e in steps for r in e['args'].get('req_ids', ())}
+        assert seen >= set(rids)
+
+        # and /debug/requests serves the same records over HTTP
+        code, body, _ = _get(base + '/debug/requests?id=' + rids[0])
+        assert json.loads(body)['requests'][0]['outcome'] == 'ok'
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /debug/trace + trace-ring machinery
+# ---------------------------------------------------------------------------
+
+def test_debug_trace_captures_live_window():
+    srv = obs.serve_telemetry(port=0)
+    stop = threading.Event()
+
+    def _spin():
+        while not stop.is_set():
+            with obs.span('t.live', n=1):
+                time.sleep(0.002)
+
+    t = threading.Thread(target=_spin, name='spinner')
+    t.start()
+    try:
+        code, body, ctype = _get(srv.url + '/debug/trace?ms=120')
+        doc = json.loads(body)
+        assert code == 200 and ctype == 'application/json'
+        assert doc['otherData']['capture_ms'] == 120.0
+        assert 'wall_origin' in doc['otherData']
+        names = {e['name'] for e in doc['traceEvents'] if e.get('ph') == 'X'}
+        assert 't.live' in names              # only the window's events
+        # thread-name metadata accompanies the captured tids
+        assert any(e.get('ph') == 'M' and e['name'] == 'thread_name'
+                   and e['args']['name'] == 'spinner'
+                   for e in doc['traceEvents'])
+    finally:
+        stop.set()
+        t.join()
+        srv.stop()
+
+
+def test_dump_trace_races_active_spans(tmp_path):
+    """dump_trace must emit valid, loadable JSON while other threads are
+    mid-span — the dump takes a consistent copy, never a torn event."""
+    stop = threading.Event()
+
+    def _spin(i):
+        while not stop.is_set():
+            with obs.span(f't.race{i}', worker=i):
+                obs.record_event('t.tick', worker=i)
+
+    threads = [threading.Thread(target=_spin, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for k in range(5):
+            path = tmp_path / f'trace{k}.json'
+            n = obs.dump_trace(str(path))
+            with open(path) as f:
+                doc = json.load(f)            # must parse every time
+            assert n == sum(1 for e in doc['traceEvents']
+                            if e.get('ph') != 'M')
+            assert all('ts' in e for e in doc['traceEvents']
+                       if e.get('ph') != 'M')
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_set_trace_cap_rebounds_ring():
+    for _ in range(20):
+        with obs.span('t.s'):
+            pass
+    assert len(obs.trace_events()) == 20
+    assert obs.set_trace_cap(5) == 5
+    assert obs.trace_cap() == 5
+    evs = obs.trace_events()
+    assert len(evs) == 5                      # newest survive the rebound
+    with obs.span('t.last'):
+        pass
+    evs = obs.trace_events()
+    assert len(evs) == 5 and evs[-1]['name'] == 't.last'
+
+
+def test_wall_anchor_reanchored_at_dump():
+    doc = obs.build_trace_doc([])
+    a = doc['otherData']
+    # wall_origin + mono_us/1e6 must reproduce the wall clock at dump time
+    assert a['wall_at_dump'] == pytest.approx(
+        a['wall_origin'] + a['mono_us_at_dump'] / 1e6, abs=5e-3)
+    assert a['wall_drift_s'] == pytest.approx(
+        a['wall_origin'] - a['wall_origin_at_import'], abs=1e-3)
+    assert a['clock'] == 'perf_counter_us_since_origin'
+    assert abs(a['wall_at_dump'] - time.time()) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_evicts_healthy_before_notable():
+    fr = _reqtrace.FlightRecorder(capacity=3, slow_ms=1000.0)
+    bad = fr.start('serve')
+    bad.note('enqueue').finish('error', RuntimeError('boom'))
+    ok_rids = []
+    for _ in range(5):
+        r = fr.start('serve')
+        ok_rids.append(r.rid)
+        r.note('enqueue').finish('ok')
+    kept = {r['id'] for r in fr.requests()}
+    assert len(fr) == 3
+    assert bad.rid in kept                    # failed outlives older healthy
+    assert ok_rids[-1] in kept and ok_rids[0] not in kept
+    assert fr.requests(outcome='error')[0]['error'] == 'RuntimeError'
+    # capacity shrink re-applies the same preference
+    fr.set_capacity(1)
+    assert {r['id'] for r in fr.requests()} == {bad.rid}
+
+
+def test_split_request_one_record_finish_idempotent():
+    fr = _reqtrace.FlightRecorder(capacity=8)
+    rec = fr.start('serve', engine='e0', rows=12)
+    rec.expect_parts(3)
+    assert rec.part_retired() is False
+    assert rec.part_retired() is False
+    assert rec.part_retired() is True         # last chunk seals it
+    rec.finish('ok')
+    rec.finish('error', RuntimeError('late'))  # first outcome wins
+    d = fr.lookup(rec.rid)
+    assert d['outcome'] == 'ok' and d['error'] is None
+    rec.note('after')                          # sealed: note is a no-op
+    assert all(e['ev'] != 'after' for e in fr.lookup(rec.rid)['timeline'])
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_is_fully_inert():
+    obs.set_enabled(False)
+    assert obs.serve_telemetry(port=0) is obs.NULL_SERVER
+    assert obs.NULL_SERVER.url == '' and obs.NULL_SERVER.port == 0
+    assert obs.NULL_SERVER.start() is obs.NULL_SERVER   # no thread/socket
+    rec = obs.start_request('serve', engine='e0')
+    assert rec is obs.NULL_RECORD and rec.rid == ''
+    assert rec.note('enqueue') is rec and rec.finish('ok') is rec
+    assert obs.recorder().requests() == []
+    assert obs.recorder().lookup('anything') is None
+    assert not any(t.name == 'paddle-tpu-telemetry'
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# obs_report --url
+# ---------------------------------------------------------------------------
+
+def test_obs_report_scrapes_live_server(capsys):
+    obs.counter('serve.requests_submitted', {'engine': 'e0'}).inc(4)
+    obs.gauge('request.active').set(2)
+    h = obs.histogram('serve.latency_ms', {'engine': 'e0'})
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    obs_report = _import_tool('obs_report')
+    assert 'request' in obs_report.NAMESPACES
+    assert 'server' in obs_report.NAMESPACES
+    srv = obs.serve_telemetry(port=0)
+    try:
+        assert obs_report.main(['--url', srv.url, '--json']) == 0
+    finally:
+        srv.stop()
+    report = json.loads(capsys.readouterr().out)
+    ns = report['namespaces']
+    # prom-mangled names still land in their namespaces
+    assert ns['serve']['counters']['serve_requests_submitted{engine=e0}'] == 4
+    assert ns['request']['gauges']['request_active'] == 2
+    hist = ns['serve']['histograms']['serve_latency_ms{engine=e0}']
+    assert hist['count'] == 4 and hist['mean'] == pytest.approx(2.5)
+    assert hist['p50'] == 3.0 and hist['p99'] == 4.0
+
+    # a dead endpoint is a loud failure (exit 2), not an empty report
+    dead = obs.serve_telemetry(port=0)
+    dead.stop()
+    assert obs_report.main(['--url', dead.url, '--json']) == 2

@@ -1,4 +1,4 @@
-"""One-page run report from an observability dump.
+"""One-page run report from an observability dump or a live process.
 
 Renders the ``snapshot.json`` (+ optional ``trace.json``) produced by
 ``observability.dump(dir)`` / ``PADDLE_TPU_OBS_DUMP=dir`` into a compact
@@ -7,19 +7,30 @@ latency tables (count / mean / p50 / p90 / p99), and — when a trace is
 present — the top span names by total self time.
 
 Run:  python tools/obs_report.py <dump_dir | snapshot.json> [--json]
+  or: python tools/obs_report.py --url http://127.0.0.1:8321
+
+``--url`` scrapes a live telemetry server's ``GET /metrics`` (the plane
+``observability.serve_telemetry`` / ``InferenceEngine(telemetry_port=)``
+exposes) and builds the same report from the Prometheus text exposition —
+no dump files needed. Note the exposition mangles dots to underscores
+(``serve.queue_wait_ms`` → ``serve_queue_wait_ms``) and summaries carry
+only the p50/p90/p99 quantiles, so a scraped report is keyed by the
+mangled names and lacks min/max.
 
 ``--json`` emits the aggregated report as JSON instead of text (for CI
-artifacts). Exits nonzero if the dump cannot be read (2) or contains no
-metrics at all (3) — an empty report in CI is a failure, not a success.
+artifacts). Exits nonzero if the dump/endpoint cannot be read (2) or
+contains no metrics at all (3) — an empty report in CI is a failure, not
+a success.
 """
 import argparse
 import collections
 import json
 import os
+import re
 import sys
 
 NAMESPACES = ('train', 'serve', 'gen', 'fault', 'ckpt', 'data', 'warmup',
-              'perf', 'slo')
+              'perf', 'slo', 'request', 'server')
 
 
 def _load(path):
@@ -46,7 +57,84 @@ def _load(path):
 def _namespace(key):
     base = key.split('{', 1)[0]
     ns = base.split('.', 1)[0]
+    if ns in NAMESPACES:
+        return ns
+    # Prometheus exposition mangles dots to underscores; a scraped key is
+    # 'serve_queue_wait_ms', not 'serve.queue_wait_ms'
+    ns = base.split('_', 1)[0]
     return ns if ns in NAMESPACES else 'other'
+
+
+# Prometheus text-exposition parsing for --url scrapes ----------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_QUANTILE_TO_PCTL = {'0.5': 'p50', '0.9': 'p90', '0.99': 'p99'}
+
+
+def _unescape_label(v):
+    return (v.replace('\\\\', '\x00').replace('\\"', '"')
+            .replace('\\n', '\n').replace('\x00', '\\'))
+
+
+def _scrape(url):
+    """GET <url>/metrics and parse the Prometheus text exposition into a
+    snapshot-shaped dict (counters/gauges/histograms keyed
+    ``name{k=v,...}``), so the rest of the report pipeline is shared with
+    the file path. Summaries come back as histogram rows with p50/p90/p99
+    + sum/count (+ derived mean)."""
+    import urllib.request
+    if not url.rstrip('/').endswith('/metrics'):
+        url = url.rstrip('/') + '/metrics'
+    with urllib.request.urlopen(url, timeout=10) as r:
+        text = r.read().decode('utf-8')
+    types, snap = {}, {'counters': {}, 'gauges': {}, 'histograms': {}}
+    summaries = collections.defaultdict(dict)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith('#'):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == 'TYPE':
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_val = m.groups()
+        try:
+            val = float(raw_val)
+        except ValueError:
+            continue
+        if val == int(val):
+            val = int(val)
+        labels = {k: _unescape_label(v)
+                  for k, v in _LABEL_RE.findall(raw_labels or '')}
+        quantile = labels.pop('quantile', None)
+        base, field = name, None
+        if name.endswith('_sum') and types.get(name[:-4]) == 'summary':
+            base, field = name[:-4], 'sum'
+        elif name.endswith('_count') and types.get(name[:-6]) == 'summary':
+            base, field = name[:-6], 'count'
+        elif quantile is not None:
+            field = _QUANTILE_TO_PCTL.get(quantile)
+            if field is None:
+                continue
+        lbl = ','.join(f'{k}={v}' for k, v in sorted(labels.items()))
+        key = f'{base}{{{lbl}}}' if lbl else base
+        if field is not None:
+            summaries[key][field] = val
+        elif types.get(name) == 'gauge':
+            snap['gauges'][key] = val
+        else:
+            snap['counters'][key] = val
+    for key, st in summaries.items():
+        if st.get('count'):
+            st['mean'] = st.get('sum', 0.0) / st['count']
+        snap['histograms'][key] = st
+    return snap
 
 
 def _group(section):
@@ -129,20 +217,30 @@ def render_text(report):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('path', help='dump directory or snapshot.json')
+    ap.add_argument('path', nargs='?', default=None,
+                    help='dump directory or snapshot.json')
+    ap.add_argument('--url', default=None, metavar='http://host:port',
+                    help='scrape a live telemetry server /metrics instead '
+                         'of reading dump files')
     ap.add_argument('--json', action='store_true',
                     help='emit the aggregated report as JSON')
     args = ap.parse_args(argv)
+    if (args.path is None) == (args.url is None):
+        ap.error('exactly one of <path> or --url is required')
+    source = args.url or args.path
     try:
-        snap, trace = _load(args.path)
+        if args.url:
+            snap, trace = _scrape(args.url), None
+        else:
+            snap, trace = _load(args.path)
     except (OSError, ValueError) as e:
-        print(f'obs_report: cannot read dump at {args.path!r}: {e}',
+        print(f'obs_report: cannot read metrics from {source!r}: {e}',
               file=sys.stderr)
         return 2
     if not any(snap.get(s) for s in ('counters', 'gauges', 'histograms')):
         # an empty snapshot in CI means the run recorded nothing — fail
         # loudly instead of printing a blank report that reads as success
-        print(f'obs_report: snapshot at {args.path!r} has no metrics '
+        print(f'obs_report: {source!r} has no metrics '
               '(was the run executed with PADDLE_TPU_OBS=0?)',
               file=sys.stderr)
         return 3

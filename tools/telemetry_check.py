@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Telemetry-plane check: a fresh engine process must serve every endpoint
+of the live telemetry plane to a real HTTP client.
+
+One fresh subprocess constructs an ``InferenceEngine(telemetry_port=0)``
+and, from inside that process, exercises the plane over real sockets:
+
+  1. ``/healthz`` answers 200 immediately (liveness precedes readiness);
+  2. ``/readyz`` is 503 BEFORE ``engine.warmup()`` and 200 after — the
+     readiness flip external routers key on;
+  3. a submitted request's ID is findable in ``/debug/requests`` with a
+     completed (enqueue → admit → retire, outcome=ok) timeline;
+  4. ``/metrics`` serves the Prometheus exposition with the correct
+     content-type and carries the engine's ``serve_*``/``request_*``
+     series;
+  5. ``/debug/trace?ms=50`` returns a chrome://tracing-loadable document
+     and ``/debug/slo`` a rule list.
+
+Prints ONE json line::
+
+  {"healthz": true, "ready_before_warmup": false, "ready_after_warmup":
+   true, "request_found": true, "timeline_ok": true, "metrics_ok": true,
+   "trace_ok": true, "slo_ok": true, "endpoints": 5, "ok": true}
+
+Exit code 0 iff every check passed.
+
+Usage: python tools/telemetry_check.py [--max-batch B]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IN_DIM, OUT_DIM = 16, 4
+
+
+def _get(url, timeout=15):
+    """(status, body_bytes, content_type) — real HTTP, errors included."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read(), r.headers.get('Content-Type', '')
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get('Content-Type', '')
+
+
+def _child(max_batch):
+    import numpy as np
+    from paddle_tpu import nn, serving
+
+    net = nn.Linear(IN_DIM, OUT_DIM)
+    engine = serving.InferenceEngine(net, max_batch_size=max_batch,
+                                     max_delay_ms=0.5, telemetry_port=0)
+    base = engine.telemetry.url
+    out = {}
+
+    code, _, _ = _get(base + '/healthz')
+    out['healthz'] = code == 200
+
+    code, _, _ = _get(base + '/readyz')
+    out['ready_before_warmup'] = code == 200      # must be False
+    engine.warmup(input_spec=[((IN_DIM,), 'float32')])
+    code, body, _ = _get(base + '/readyz')
+    out['ready_after_warmup'] = code == 200
+
+    fut = engine.submit(np.ones((2, IN_DIM), np.float32))
+    fut.result(timeout=120)
+    rid = fut.request_id
+    code, body, _ = _get(base + '/debug/requests?id=' + rid)
+    reqs = json.loads(body).get('requests', []) if code == 200 else []
+    out['request_found'] = bool(reqs) and reqs[0]['id'] == rid
+    evs = [e['ev'] for e in reqs[0]['timeline']] if reqs else []
+    out['timeline_ok'] = ('enqueue' in evs and 'admit' in evs
+                          and 'retire' in evs
+                          and reqs[0]['outcome'] == 'ok') if reqs else False
+
+    code, body, ctype = _get(base + '/metrics')
+    text = body.decode('utf-8')
+    out['metrics_ok'] = (code == 200 and ctype.startswith('text/plain')
+                         and 'version=0.0.4' in ctype
+                         and 'serve_requests_submitted' in text
+                         and 'request_started' in text)
+
+    code, body, _ = _get(base + '/debug/trace?ms=50')
+    try:
+        doc = json.loads(body)
+        out['trace_ok'] = code == 200 and 'traceEvents' in doc \
+            and 'wall_origin' in doc.get('otherData', {})
+    except ValueError:
+        out['trace_ok'] = False
+
+    code, body, _ = _get(base + '/debug/slo')
+    try:
+        out['slo_ok'] = code == 200 and 'rules' in json.loads(body)
+    except ValueError:
+        out['slo_ok'] = False
+
+    engine.shutdown()
+    out['endpoints'] = 5
+    print(json.dumps(out))
+
+
+def run_check(max_batch=8, timeout=600):
+    """Run the fresh-subprocess check; returns the summary dict (importable
+    from bench.py and the test suite)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child',
+         '--max-batch', str(max_batch)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'telemetry child failed:\n{proc.stdout}\n'
+                           f'{proc.stderr}')
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out['ok'] = bool(out['healthz']
+                     and not out['ready_before_warmup']
+                     and out['ready_after_warmup']
+                     and out['request_found'] and out['timeline_ok']
+                     and out['metrics_ok'] and out['trace_ok']
+                     and out['slo_ok'])
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--max-batch', type=int, default=8)
+    ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.max_batch)
+        return 0
+    result = run_check(max_batch=args.max_batch)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
