@@ -834,6 +834,22 @@ def main(fast=False):
     out['relay_tcp'] = _relay_tcp_state()
     print(f'relay tcp state: {out["relay_tcp"]}', file=sys.stderr)
 
+    # static-analysis gate (tools/lint.py, no jax/devices — sub-second):
+    # regressions in trace hygiene / lock order / sharding tables show up
+    # in the bench row even when nobody ran the test suite
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        lr = subprocess.run(
+            [sys.executable, os.path.join(repo, 'tools', 'lint.py'),
+             os.path.join(repo, 'paddle_tpu'), '--json'],
+            capture_output=True, text=True, timeout=120)
+        lint = json.loads(lr.stdout)
+        out['lint_findings'] = int(lint.get('total', -1))
+        out['lint_ok'] = bool(lint.get('ok')) and lr.returncode == 0
+    except Exception as e:   # noqa: BLE001 — the gate must not sink bench
+        print(f'lint gate failed to run: {e!r}', file=sys.stderr)
+        out['lint_ok'] = False
+
     probe = None
     timeouts = ([PROBE_TIMEOUT_S] if fast
                 else [PROBE_TIMEOUT_S, 120, 120][:PROBE_RETRIES])

@@ -1,0 +1,29 @@
+"""paddle_tpu.analysis — AST static analysis for TPU-native invariants.
+
+Three passes over the source tree (no imports of the code under analysis,
+no jax, no devices — pure ``ast``):
+
+  trace_hygiene   host-sync / nondeterminism / closure-capture / donation
+                  hazards in functions that reach ``jax.jit``
+  lock_order      static lock-acquisition graph: deadlock cycles, device
+                  calls and blocking waits under locks
+  sharding_rules  LOGICAL_AXES tables validated against the partitioner
+                  rules tables without constructing a mesh
+
+Entry points:
+
+    from paddle_tpu.analysis import run
+    findings, n_files = run(['paddle_tpu'])
+
+or the CLI (the CI gate): ``python tools/lint.py paddle_tpu --json``.
+
+Suppression: ``# pt-lint: disable=<rule>`` inline pragmas and the
+checked-in ``tools/lint_baseline.json`` (see core.py docstring).
+"""
+from .core import (RULES, Baseline, Finding, Rule, assign_keys,  # noqa: F401
+                   load_sources, run)
+from . import lock_order, sharding_rules, trace_hygiene  # noqa: F401
+
+__all__ = ['RULES', 'Baseline', 'Finding', 'Rule', 'assign_keys',
+           'load_sources', 'run', 'trace_hygiene', 'lock_order',
+           'sharding_rules']

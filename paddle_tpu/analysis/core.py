@@ -1,0 +1,245 @@
+"""Shared static-analysis framework: rules, findings, pragmas, baseline.
+
+The analysis package is a *lint-time* tool: it parses source with ``ast``
+and never imports the code under analysis (and never imports jax itself),
+so ``tools/lint.py`` runs in milliseconds-per-file on any machine — no
+device, no mesh, no backend initialization. Three passes build on this
+core (trace_hygiene, lock_order, sharding_rules); each pass is a callable
+``pass_fn(sources) -> [Finding]`` over the WHOLE scanned file set, so
+cross-module analyses (the lock graph, the canonical sharding vocabulary)
+see everything at once.
+
+Suppression has two layers, both consumed by CI:
+
+  - inline pragmas — ``# pt-lint: disable=rule-a,rule-b`` on the flagged
+    line (or alone on the line above) acknowledges a deliberate pattern
+    next to the code itself; ``disable=all`` and a file-wide
+    ``# pt-lint: disable-file=rule`` form exist for generated files,
+  - a checked-in baseline (tools/lint_baseline.json) — grandfathered
+    findings keyed on (rule, path, enclosing context, message), NOT on
+    line numbers, so unrelated edits don't churn the file. Every entry
+    carries a human ``reason``; stale entries are reported so the
+    baseline only ever shrinks.
+"""
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+RULES = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str            # kebab-case, e.g. 'trace-host-sync'
+    summary: str       # one line, shown by ``lint.py --list-rules``
+    pass_name: str     # 'trace' | 'lock' | 'shard' | 'core'
+
+
+def register_rule(id, summary, pass_name):
+    rule = Rule(id, summary, pass_name)
+    RULES[id] = rule
+    return rule
+
+
+PARSE_ERROR = register_rule(
+    'parse-error', 'file could not be parsed as Python', 'core')
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str              # forward-slash relpath from the scan root
+    line: int
+    col: int
+    message: str           # line-number free (stable under edits)
+    context: str = '<module>'   # enclosing function/class qualname
+    key: str = ''          # assigned by assign_keys()
+
+    def format(self):
+        return (f'{self.path}:{self.line}:{self.col}: {self.rule} '
+                f'{self.message} [{self.context}]')
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+def assign_keys(findings):
+    """Stable baseline keys: hash of (rule, path, context, message) plus an
+    ordinal so N identical findings need N baseline entries. Line/col are
+    deliberately excluded — moving code must not invalidate the baseline."""
+    seen = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        h = hashlib.sha1(
+            f'{f.rule}|{f.path}|{f.context}|{f.message}'.encode()
+        ).hexdigest()[:12]
+        n = seen[h] = seen.get(h, 0) + 1
+        f.key = f'{f.rule}:{f.path}:{h}' + (f'#{n}' if n > 1 else '')
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Source files + pragmas
+# ---------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r'#\s*pt-lint\s*:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_\-, ]+)')
+
+
+class SourceFile:
+    """One parsed file: text, AST, and the pragma suppression map."""
+
+    def __init__(self, path, relpath, text):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, '/')
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = None
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:
+            self.parse_error = e
+        self._line_disables = {}   # lineno -> set of rule ids ('all' ok)
+        self._file_disables = set()
+        self._scan_pragmas()
+
+    @classmethod
+    def read(cls, path, root):
+        with open(path, encoding='utf-8') as fh:
+            text = fh.read()
+        return cls(path, os.path.relpath(path, root), text)
+
+    def _scan_pragmas(self):
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            kind, names = m.group(1), m.group(2)
+            rules = {r.strip() for r in names.split(',') if r.strip()}
+            if kind == 'disable-file':
+                self._file_disables |= rules
+            else:
+                self._line_disables.setdefault(i, set()).update(rules)
+                # a pragma alone on a comment line covers the next line
+                if line.strip().startswith('#'):
+                    self._line_disables.setdefault(i + 1, set()).update(rules)
+
+    def suppressed(self, finding):
+        for pool in (self._file_disables,
+                     self._line_disables.get(finding.line, ())):
+            if 'all' in pool or finding.rule in pool:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+class Baseline:
+    """Checked-in grandfather list. Matching consumes entries, so a key
+    baselined once suppresses exactly one finding; leftovers are stale."""
+
+    def __init__(self, entries=None):
+        self.entries = list(entries or [])
+        self._pool = {}
+        for e in self.entries:
+            self._pool[e['key']] = self._pool.get(e['key'], 0) + 1
+
+    @classmethod
+    def load(cls, path):
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path, encoding='utf-8') as fh:
+            data = json.load(fh)
+        return cls(data.get('entries', []))
+
+    def save(self, path):
+        data = {'version': 1, 'entries': self.entries}
+        with open(path, 'w', encoding='utf-8') as fh:
+            json.dump(data, fh, indent=1, sort_keys=True)
+            fh.write('\n')
+
+    def match(self, finding):
+        n = self._pool.get(finding.key, 0)
+        if n <= 0:
+            return False
+        self._pool[finding.key] = n - 1
+        return True
+
+    def stale_keys(self):
+        return sorted(k for k, n in self._pool.items() if n > 0)
+
+    @classmethod
+    def from_findings(cls, findings, reason='grandfathered'):
+        return cls([{'key': f.key, 'rule': f.rule, 'path': f.path,
+                     'context': f.context, 'message': f.message,
+                     'reason': reason} for f in findings])
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {'__pycache__', '.git', 'build', 'dist', '.eggs', 'node_modules'}
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith('.py'):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith('.py'):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_sources(paths, root=None):
+    root = root or os.getcwd()
+    return [SourceFile.read(p, root) for p in iter_py_files(paths)]
+
+
+def default_passes():
+    from . import lock_order, sharding_rules, trace_hygiene
+    return [trace_hygiene.run_pass, lock_order.run_pass,
+            sharding_rules.run_pass]
+
+
+def run(paths, root=None, passes=None, rules=None):
+    """Run every pass over ``paths`` -> (findings, n_files).
+
+    Pragma-suppressed findings are dropped here; baseline handling is the
+    caller's (CLI/test) concern so programmatic users see the full list.
+    ``rules`` optionally restricts to a set of rule ids.
+    """
+    sources = load_sources(paths, root=root)
+    findings = []
+    for src in sources:
+        if src.parse_error is not None:
+            e = src.parse_error
+            findings.append(Finding(PARSE_ERROR.id, src.relpath,
+                                    e.lineno or 1, (e.offset or 1) - 1,
+                                    f'syntax error: {e.msg}'))
+    parsed = [s for s in sources if s.tree is not None]
+    for pass_fn in (passes if passes is not None else default_passes()):
+        findings.extend(pass_fn(parsed))
+    by_path = {s.relpath: s for s in sources}
+    findings = [f for f in findings
+                if not (f.path in by_path and by_path[f.path].suppressed(f))]
+    if rules:
+        findings = [f for f in findings if f.rule in set(rules)]
+    return assign_keys(findings), len(sources)

@@ -1,0 +1,562 @@
+"""Pass 2 — lock order: a static lock-acquisition graph over every
+``threading.Lock``/``RLock``/``Condition`` holder in the scanned set.
+
+The dispatch thread (serving/engine), the generation scheduler
+(serving/generation), the telemetry HTTP plane (observability/server),
+the metrics registry, the flight recorder and the warmup capture hooks
+all hold locks while calling into each other — 24 lock sites across 17
+modules with no machine-checked deadlock story until this pass.
+
+How it works, entirely on the AST:
+
+  1. every ``self.x = threading.Lock()`` (and module-level ``_lock = …``)
+     becomes a lock node identified ``module.Class.attr``;
+     ``Condition(self._lock)`` aliases the wrapped lock,
+  2. every function body is walked in statement order with a held-lock
+     stack (``with lock:`` blocks, bare ``.acquire()``/``.release()``
+     pairs), recording acquisitions, calls and hazards made under a lock,
+  3. calls are resolved interprocedurally — same-class ``self.m()``,
+     module functions, imported ``mod.f()``, and attribute receivers whose
+     class is known from ``self.x = ClassName(...)`` assignments — and
+     lock/hazard summaries propagate through the call graph to a fixpoint,
+  4. the resulting ordered-acquisition digraph is checked for cycles, and
+     held regions are checked for device calls / blocking waits.
+
+Rules:
+
+  lock-cycle          two lock orders that can deadlock (A->B in one
+                      thread, B->A in another), or re-acquisition of a
+                      non-reentrant Lock in one static path.
+  lock-device-call    device work (block_until_ready, device_put, …)
+                      executed while a lock is held — a slow/stuck device
+                      call freezes every thread contending on the lock.
+  lock-blocking-call  sleeps, thread joins, foreign Event/Condition waits
+                      or subprocess calls under a lock (waiting on the
+                      HELD condition variable is of course fine).
+
+``Condition.wait`` on the held lock, lock-free fast paths, etc. are
+recognized; deliberate exceptions carry ``# pt-lint: disable=...``.
+"""
+import ast
+
+from .core import Finding, register_rule
+from .trace_hygiene import _dotted, walk_scope
+
+R_CYCLE = register_rule(
+    'lock-cycle', 'lock-order cycle or non-reentrant re-acquisition',
+    'lock')
+R_DEVICE = register_rule(
+    'lock-device-call', 'device call while holding a lock', 'lock')
+R_BLOCKING = register_rule(
+    'lock-blocking-call', 'blocking wait/sleep/join while holding a lock',
+    'lock')
+
+_LOCK_CTORS = {'Lock': 'lock', 'RLock': 'rlock', 'Condition': 'condition',
+               'Semaphore': 'lock', 'BoundedSemaphore': 'lock'}
+
+_DEVICE_ATTRS = {'block_until_ready', 'copy_to_host_async'}
+_DEVICE_CALLS = {'jax.device_put', 'jax.device_get',
+                 'jax.block_until_ready', 'jax.live_arrays'}
+_SUBPROCESS = {'subprocess.run', 'subprocess.call', 'subprocess.check_call',
+               'subprocess.check_output', 'subprocess.Popen'}
+
+
+def _mod_of(src):
+    return src.relpath[:-3].replace('/', '.')
+
+
+class _FnSummary:
+    __slots__ = ('qualname', 'path', 'acquires', 'edges', 'held_calls',
+                 'held_hazards', 'calls', 'hazards', 'line')
+
+    def __init__(self, qualname, path, line):
+        self.qualname = qualname
+        self.path = path
+        self.line = line
+        self.acquires = set()      # direct lock ids
+        self.edges = []            # (held_id, acquired_id, line)
+        self.held_calls = []       # (held_id, target_key, line)
+        self.held_hazards = []     # (held_id, rule, detail, line)
+        self.calls = set()         # target_key (anywhere in body)
+        self.hazards = []          # (rule, detail, line) direct, lock-free
+
+
+class _Module:
+    def __init__(self, src):
+        self.src = src
+        self.name = _mod_of(src)
+        self.imports = {}          # local name -> dotted target
+        self.classes = {}          # cls -> {'locks': {attr: (kind, id)},
+                                   #         'alias': {attr: attr},
+                                   #         'attr_types': {attr: clsref},
+                                   #         'methods': {name: _FnSummary}}
+        self.locks = {}            # module-level var -> (kind, id)
+        self.funcs = {}            # name -> _FnSummary
+
+
+def _target_class(call_func, imports, module):
+    """A constructor call target -> ('mod.Class') if resolvable."""
+    d = _dotted(call_func)
+    if d is None:
+        return None
+    head = d.split('.')[0]
+    if d in imports:
+        return imports[d]
+    if head in imports and '.' in d:
+        return imports[head] + d[len(head):]
+    if d[:1].isupper() or d.split('.')[-1][:1].isupper():
+        return f'{module}.{d}'
+    return None
+
+
+def _lock_ctor(call, threading_aliases):
+    if not isinstance(call, ast.Call):
+        return None
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split('.')
+    name = parts[-1]
+    if name not in _LOCK_CTORS:
+        return None
+    if len(parts) > 1 and parts[0] not in threading_aliases:
+        return None
+    return _LOCK_CTORS[name]
+
+
+def _collect_module(src):
+    mod = _Module(src)
+    threading_aliases = {'threading'}
+    for node in src.tree.body:
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                local = al.asname or al.name.split('.')[0]
+                mod.imports[local] = al.name
+                if al.name == 'threading':
+                    threading_aliases.add(local)
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ''
+            if node.level:
+                # resolve relative imports against this file's package
+                pkg = mod.name.split('.')[:-node.level]
+                base = '.'.join(pkg + ([node.module] if node.module else []))
+            for al in node.names:
+                local = al.asname or al.name
+                mod.imports[local] = f'{base}.{al.name}' if base else al.name
+                if base == 'threading':
+                    threading_aliases.add(al.name)
+        elif isinstance(node, ast.Assign):
+            kind = _lock_ctor(node.value, threading_aliases)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mod.locks[t.id] = (kind,
+                                           f'{mod.name}.{t.id}')
+    mod._threading_aliases = threading_aliases
+    return mod
+
+
+def _scan_class(mod, cls_node):
+    info = {'locks': {}, 'alias': {}, 'attr_types': {}, 'methods': {}}
+    mod.classes[cls_node.name] = info
+    for item in cls_node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in walk_scope(item):
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == 'self'):
+                    continue
+                kind = _lock_ctor(n.value, mod._threading_aliases)
+                if kind:
+                    # Condition(self._lock) aliases the wrapped lock
+                    if kind == 'condition' and isinstance(n.value, ast.Call) \
+                            and n.value.args:
+                        a0 = n.value.args[0]
+                        if isinstance(a0, ast.Attribute) and \
+                                isinstance(a0.value, ast.Name) and \
+                                a0.value.id == 'self':
+                            info['alias'][t.attr] = a0.attr
+                            continue
+                    info['locks'][t.attr] = (
+                        kind, f'{mod.name}.{cls_node.name}.{t.attr}')
+                elif isinstance(n.value, ast.Call):
+                    ref = _target_class(n.value.func, mod.imports, mod.name)
+                    if ref:
+                        info['attr_types'][t.attr] = ref
+
+
+class _Registry:
+    """Global view used for call/lock resolution across modules."""
+
+    def __init__(self, modules):
+        self.modules = {m.name: m for m in modules}
+        self.classes = {}          # 'mod.Cls' -> (mod, info)
+        for m in modules:
+            for cname, info in m.classes.items():
+                self.classes[f'{m.name}.{cname}'] = (m, info)
+
+    def find_class(self, ref):
+        """ref may carry a shorter module path than the scanned relpath
+        (imports resolve against the package root, relpaths against the
+        scan root) — match on suffix."""
+        if ref in self.classes:
+            return self.classes[ref]
+        tail = ref.split('.')
+        for key, val in self.classes.items():
+            parts = key.split('.')
+            if parts[-1] == tail[-1] and (
+                    len(tail) < 2 or parts[-2:] == tail[-2:]):
+                return val
+        return None
+
+
+def _resolve_lock_expr(expr, mod, cls_info):
+    """A with-context / receiver expression -> (kind, lock_id) or None."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == 'self' and cls_info is not None:
+        attr = expr.attr
+        attr = cls_info['alias'].get(attr, attr)
+        return cls_info['locks'].get(attr)
+    if isinstance(expr, ast.Name):
+        return mod.locks.get(expr.id)
+    return None
+
+
+def _call_target_key(call, mod, cls_name):
+    """Stable key describing what a call invokes, resolved later."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ('func', mod.name, f.id)
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id == 'self' and cls_name:
+                return ('method', f'{mod.name}.{cls_name}', f.attr)
+            if base.id in mod.imports:
+                return ('modfunc', mod.imports[base.id], f.attr)
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and base.value.id == 'self' \
+                and cls_name:
+            info = mod.classes.get(cls_name)
+            ref = info and info['attr_types'].get(base.attr)
+            if ref:
+                return ('method', ref, f.attr)
+    return None
+
+
+def _classify_hazard(call, mod, cls_info, held):
+    """-> (rule, detail) when the call blocks/hits the device."""
+    d = _dotted(call.func)
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _DEVICE_ATTRS:
+            return (R_DEVICE, f'.{f.attr}()')
+        if f.attr == 'wait':
+            tgt = _resolve_lock_expr(f.value, mod, cls_info)
+            if tgt is not None and tgt[1] in held:
+                return None          # cv.wait on the HELD lock releases it
+            base = _dotted(f.value) or '<expr>'
+            return (R_BLOCKING, f'{base}.wait()')
+        if f.attr == 'join':
+            base = (_dotted(f.value) or '').lower()
+            if 'thread' in base or 'proc' in base or 'pool' in base:
+                return (R_BLOCKING, f'{_dotted(f.value)}.join()')
+        if f.attr == 'result':
+            base = (_dotted(f.value) or '').lower()
+            if 'fut' in base:
+                return (R_BLOCKING, f'{_dotted(f.value)}.result()')
+    if d is None:
+        return None
+    if d in _DEVICE_CALLS:
+        return (R_DEVICE, f'{d}()')
+    if d == 'time.sleep':
+        return (R_BLOCKING, 'time.sleep()')
+    if d in _SUBPROCESS or d.endswith('.urlopen') or d == 'urlopen':
+        return (R_BLOCKING, f'{d}()')
+    return None
+
+
+def _walk_fn(summary, body, held, mod, cls_name, cls_info):
+    """Ordered statement walk with a held-lock stack."""
+    i = 0
+    stmts = list(body)
+    while i < len(stmts):
+        stmt = stmts[i]
+        i += 1
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.With):
+            new = []
+            for item in stmt.items:
+                tgt = _resolve_lock_expr(item.context_expr, mod, cls_info)
+                if tgt is not None:
+                    _note_acquire(summary, tgt, held + new,
+                                  item.context_expr.lineno)
+                    new.append(tgt[1])
+                else:
+                    _scan_exprs(summary, item.context_expr, held, mod,
+                                cls_name, cls_info)
+            _walk_fn(summary, stmt.body, held + new, mod, cls_name, cls_info)
+            continue
+        # bare lock.acquire(): held for the REST of this block (or until
+        # a matching release in the same block)
+        acq = _bare_acquire(stmt, mod, cls_info)
+        if acq is not None:
+            _note_acquire(summary, acq, held, stmt.lineno)
+            rest = _until_release(stmts[i:], acq, mod, cls_info)
+            _walk_fn(summary, rest, held + [acq[1]], mod, cls_name, cls_info)
+            i += len(rest)
+            continue
+        # compound statements: recurse into bodies with the same held set
+        for attr in ('body', 'orelse', 'finalbody'):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                _walk_fn(summary, sub, held, mod, cls_name, cls_info)
+        for h in getattr(stmt, 'handlers', []) or []:
+            _walk_fn(summary, h.body, held, mod, cls_name, cls_info)
+        # expressions hanging off this statement (test/value/targets...)
+        for field in ast.iter_child_nodes(stmt):
+            if not isinstance(field, (ast.stmt, ast.excepthandler)):
+                _scan_exprs(summary, field, held, mod, cls_name, cls_info)
+
+
+def _note_acquire(summary, lock, held, line):
+    kind, lock_id = lock
+    summary.acquires.add(lock_id)
+    if lock_id in held and kind == 'lock':
+        summary.held_hazards.append(
+            (lock_id, R_CYCLE,
+             f're-acquisition of non-reentrant lock {lock_id}', line))
+    for h in held:
+        if h != lock_id:
+            summary.edges.append((h, lock_id, line))
+
+
+def _bare_acquire(stmt, mod, cls_info):
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr == 'acquire':
+            return _resolve_lock_expr(f.value, mod, cls_info)
+    return None
+
+
+def _until_release(stmts, lock, mod, cls_info):
+    out = []
+    for s in stmts:
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            f = s.value.func
+            if isinstance(f, ast.Attribute) and f.attr == 'release' and \
+                    _resolve_lock_expr(f.value, mod, cls_info) == lock:
+                break
+        out.append(s)
+    return out
+
+
+def _scan_exprs(summary, node, held, mod, cls_name, cls_info):
+    """Record calls/hazards inside an expression tree (no nested scopes)."""
+    for n in walk_scope_expr(node):
+        if not isinstance(n, ast.Call):
+            continue
+        hz = _classify_hazard(n, mod, cls_info, held)
+        if hz is not None:
+            if held:
+                summary.held_hazards.append(
+                    (held[-1], hz[0], hz[1], n.lineno))
+            else:
+                summary.hazards.append((hz[0], hz[1], n.lineno))
+            continue
+        key = _call_target_key(n, mod, cls_name)
+        if key is not None:
+            summary.calls.add(key)
+            if held:
+                summary.held_calls.append((held[-1], key, n.lineno))
+
+
+def walk_scope_expr(node):
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+
+def _summarize(mod):
+    """Build _FnSummary for every module function and class method."""
+    for node in mod.src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            s = _FnSummary(node.name, mod.src.relpath, node.lineno)
+            mod.funcs[node.name] = s
+            _walk_fn(s, node.body, [], mod, None, None)
+        elif isinstance(node, ast.ClassDef):
+            info = mod.classes.get(node.name)
+            if info is None:
+                continue
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    s = _FnSummary(f'{node.name}.{item.name}',
+                                   mod.src.relpath, item.lineno)
+                    info['methods'][item.name] = s
+                    _walk_fn(s, item.body, [], mod, node.name, info)
+
+
+def _resolve_call(reg, key):
+    kind = key[0]
+    if kind == 'func':
+        _, modname, fname = key
+        m = reg.modules.get(modname)
+        if m and fname in m.funcs:
+            return m.funcs[fname]
+        # constructor? ClassName(...) -> __init__
+        found = reg.find_class(f'{modname}.{fname}')
+        if found:
+            return found[1]['methods'].get('__init__')
+        return None
+    if kind == 'method':
+        _, clsref, mname = key
+        found = reg.find_class(clsref)
+        if found:
+            return found[1]['methods'].get(mname)
+        return None
+    if kind == 'modfunc':
+        _, modref, fname = key
+        m = reg.modules.get(modref)
+        if m is None:
+            for name, cand in reg.modules.items():
+                if name.endswith('.' + modref.split('.')[-1]):
+                    m = cand
+                    break
+        if m and fname in m.funcs:
+            return m.funcs[fname]
+        found = reg.find_class(f'{modref}.{fname}')
+        if found:
+            return found[1]['methods'].get('__init__')
+    return None
+
+
+def _fixpoint(reg, all_fns):
+    """Transitive acquire/hazard closures over the call graph."""
+    acq = {id(f): set(f.acquires) for f in all_fns}
+    haz = {id(f): {(r.id, d) for r, d, _ in f.hazards} for f in all_fns}
+    callees = {id(f): [c for c in (_resolve_call(reg, k) for k in f.calls)
+                       if c is not None] for f in all_fns}
+    changed = True
+    while changed:
+        changed = False
+        for f in all_fns:
+            a, h = acq[id(f)], haz[id(f)]
+            for g in callees[id(f)]:
+                if not acq[id(g)] <= a:
+                    a |= acq[id(g)]
+                    changed = True
+                if not haz[id(g)] <= h:
+                    h |= haz[id(g)]
+                    changed = True
+    return acq, haz
+
+
+def run_pass(sources):
+    modules = []
+    for src in sources:
+        mod = _collect_module(src)
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                _scan_class(mod, node)
+        _summarize(mod)
+        modules.append(mod)
+    reg = _Registry(modules)
+    all_fns = [f for m in modules for f in m.funcs.values()] + \
+              [s for m in modules for info in m.classes.values()
+               for s in info['methods'].values()]
+    acq, haz = _fixpoint(reg, all_fns)
+
+    findings = []
+    edges = {}          # (a, b) -> (path, line, qualname)
+
+    for f in all_fns:
+        for a, b, line in f.edges:
+            edges.setdefault((a, b), (f.path, line, f.qualname))
+        for held, rule, detail, line in f.held_hazards:
+            findings.append(Finding(
+                rule.id, f.path, line, 0,
+                f'{detail} while holding {held}', f.qualname))
+        seen = set()
+        for held, key, line in f.held_calls:
+            g = _resolve_call(reg, key)
+            if g is None:
+                continue
+            for b in acq[id(g)]:
+                if b != held:
+                    edges.setdefault(
+                        (held, b), (f.path, line, f.qualname))
+            for rule_id, detail in haz[id(g)]:
+                tag = (held, rule_id, g.qualname)
+                if tag in seen:
+                    continue
+                seen.add(tag)
+                findings.append(Finding(
+                    rule_id, f.path, line, 0,
+                    f'{detail} (via {g.qualname}) while holding {held}',
+                    f.qualname))
+
+    findings.extend(_cycle_findings(edges))
+    return findings
+
+
+def _cycle_findings(edges):
+    graph = {}
+    for (a, b), site in edges.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # Tarjan SCC
+    index, low, onstack, stack = {}, {}, set(), []
+    sccs, counter = [], [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        internal = [((a, b), edges[(a, b)]) for (a, b) in edges
+                    if a in comp and b in comp]
+        internal.sort(key=lambda e: (e[1][0], e[1][1]))
+        (a, b), (path, line, qual) = internal[0]
+        findings.append(Finding(
+            R_CYCLE.id, path, line, 0,
+            'lock-order cycle (possible deadlock): '
+            + ' -> '.join(comp + [comp[0]]), qual))
+    return findings

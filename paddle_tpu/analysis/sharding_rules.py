@@ -1,0 +1,221 @@
+"""Pass 3 — sharding rules: validate every model's ``LOGICAL_AXES`` table
+against the partitioner rules tables WITHOUT constructing a mesh.
+
+PR 8 moved all placement policy into declarative tables: models annotate
+parameters with logical axis names and ``parallel/partitioner.py`` maps
+names to mesh axes through an ordered first-match-wins rules table. The
+failure modes are now *table* bugs — a typo'd axis name silently resolves
+to replicated (the partitioner's documented safety default), a shadowed
+rule is dead weight that lies to the reader, a spec that resolves one
+mesh axis twice silently replicates the second dim. None of these raise
+until a mesh exists, and the memory cost of accidental replication only
+shows up on a real v5p slice. This pass catches all three at lint time,
+t5x fail-fast style.
+
+Everything is extracted from the AST:
+
+  - rules tables — module-level ``*RULES*`` tuple-of-pairs literals plus
+    tuple literals returned from ``*rules*`` functions (``model_rules``'s
+    conditional entries become *dynamic* axes, exempt from the reachability
+    and reuse checks since they can legitimately resolve to None),
+  - ``LOGICAL_AXES`` dicts — arbitrarily nested, each leaf a tuple of
+    logical names with its own source line.
+
+A file defining its own rules table is validated self-contained (this is
+how the test fixtures work); otherwise the canonical vocabulary is the
+union of every table in ``parallel/partitioner.py`` found in the scanned
+set.
+
+Rules:
+
+  shard-unknown-axis   a LOGICAL_AXES leaf names an axis no rules table
+                       mentions — typo'd names silently replicate.
+  shard-shadowed-rule  a rules-table entry that can never match: an
+                       earlier entry for the same name either replicates
+                       (scan stops at None) or is identical.
+  shard-mesh-reuse     one tensor's logical axes resolve the same mesh
+                       axis twice — the runtime silently replicates the
+                       later dim, which is almost never intended.
+"""
+import ast
+
+from .core import Finding, register_rule
+
+R_UNKNOWN = register_rule(
+    'shard-unknown-axis',
+    'logical axis not covered by any partitioner rule', 'shard')
+R_SHADOW = register_rule(
+    'shard-shadowed-rule',
+    'unreachable (shadowed) partitioner rule', 'shard')
+R_REUSE = register_rule(
+    'shard-mesh-reuse',
+    'one spec resolves the same mesh axis twice', 'shard')
+
+_DYNAMIC = object()     # non-literal mesh axis (IfExp etc.)
+
+
+def _literal_axis(node):
+    """A rules-table mesh-axis value -> str | None | tuple | _DYNAMIC."""
+    if isinstance(node, ast.Constant) and (
+            node.value is None or isinstance(node.value, str)):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return _DYNAMIC
+        return tuple(out)
+    return _DYNAMIC
+
+
+def _extract_table(node):
+    """A tuple/list literal of (name, axis) pairs -> [(name, ax, line)]
+    or None when the shape doesn't match a rules table."""
+    if not isinstance(node, (ast.Tuple, ast.List)) or not node.elts:
+        return None
+    entries = []
+    for elt in node.elts:
+        if not (isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return None
+        k = elt.elts[0]
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        entries.append((k.value, _literal_axis(elt.elts[1]), elt.lineno))
+    return entries
+
+
+def _tables_in(src):
+    """[(table_name, entries)] from one file."""
+    out = []
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and 'RULES' in t.id.upper():
+                    tab = _extract_table(node.value)
+                    if tab:
+                        out.append((t.id, tab))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and 'rules' in node.name.lower():
+            for n in ast.walk(node):
+                if isinstance(n, ast.Return) and n.value is not None:
+                    tab = _extract_table(n.value)
+                    if tab:
+                        out.append((node.name, tab))
+    return out
+
+
+def _logical_axes_leaves(node, path=''):
+    """Yield (dotted_key_path, [axis names], lineno) from a nested dict."""
+    if not isinstance(node, ast.Dict):
+        return
+    for k, v in zip(node.keys, node.values):
+        key = k.value if isinstance(k, ast.Constant) else '<dyn>'
+        sub = f'{path}.{key}' if path else str(key)
+        if isinstance(v, ast.Dict):
+            yield from _logical_axes_leaves(v, sub)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            names = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant):
+                    names.append(elt.value)
+                else:
+                    names.append(None)
+            yield sub, names, v.lineno
+
+
+def _logical_tables(src):
+    out = []
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and 'LOGICAL_AXES' in t.id:
+                    out.extend(_logical_axes_leaves(node.value))
+    return out
+
+
+def _resolve(name, table):
+    """First-match-wins resolution of one logical name, partitioner
+    semantics (None rule stops the scan -> replicated)."""
+    for rname, ax, _ in table:
+        if rname != name:
+            continue
+        return ax
+    return None
+
+
+def _check_shadowed(src, tname, table, findings):
+    for i, (name, ax, line) in enumerate(table):
+        for pname, pax, _ in table[:i]:
+            if pname != name:
+                continue
+            if pax is None:
+                findings.append(Finding(
+                    R_SHADOW.id, src.relpath, line, 0,
+                    f'rule ({name!r} -> {ax!r}) in {tname} is unreachable: '
+                    f'an earlier ({name!r} -> None) rule stops the scan at '
+                    'replicated', f'{tname}'))
+                break
+            if pax is not _DYNAMIC and pax == ax:
+                findings.append(Finding(
+                    R_SHADOW.id, src.relpath, line, 0,
+                    f'rule ({name!r} -> {ax!r}) in {tname} duplicates an '
+                    'earlier identical rule and can never apply',
+                    f'{tname}'))
+                break
+
+
+def _check_leaves(src, leaves, tables, findings):
+    vocab = set()
+    for _, table in tables:
+        vocab.update(name for name, _, _ in table)
+    for key, names, line in leaves:
+        for name in names:
+            if name is None:
+                continue
+            if name not in vocab:
+                findings.append(Finding(
+                    R_UNKNOWN.id, src.relpath, line, 0,
+                    f'logical axis {name!r} of {key!r} matches no '
+                    'partitioner rule — a typo here silently replicates '
+                    'the dim', 'LOGICAL_AXES'))
+        # mesh-axis reuse: resolve every dim independently per table
+        for tname, table in tables:
+            used = {}
+            for name in names:
+                if name is None:
+                    continue
+                ax = _resolve(name, table)
+                if ax in (None, _DYNAMIC):
+                    continue
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    if a in used and used[a] != name:
+                        findings.append(Finding(
+                            R_REUSE.id, src.relpath, line, 0,
+                            f'{key!r} resolves mesh axis {a!r} twice '
+                            f'({used[a]!r} and {name!r} via {tname}) — '
+                            'the runtime silently replicates the second '
+                            'dim', 'LOGICAL_AXES'))
+                    used[a] = name
+
+
+def run_pass(sources):
+    findings = []
+    canonical = []
+    for src in sources:
+        if src.relpath.endswith('parallel/partitioner.py'):
+            canonical.extend(_tables_in(src))
+    for src in sources:
+        own = _tables_in(src)
+        for tname, table in own:
+            _check_shadowed(src, tname, table, findings)
+        leaves = _logical_tables(src)
+        if not leaves:
+            continue
+        tables = own or canonical
+        if not tables:
+            continue        # nothing to validate against in this scan set
+        _check_leaves(src, leaves, tables, findings)
+    return findings

@@ -112,6 +112,8 @@ class ElasticManager:
     def mark_done(self):
         """Record CLEAN job completion: peers must not treat this node's
         departure as a failure/scale event (see poll)."""
+        if self.store is None:      # never registered: nothing advertised
+            return
         try:
             self.store.put(self._done_key(self.node_id), 'done')
         except Exception:       # noqa: BLE001 — see _beat
@@ -121,6 +123,8 @@ class ElasticManager:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2 * self.interval)
+        if self.store is None:      # never registered: only stop the beat
+            return
         self.store.delete(self._key(self.node_id))
         self.store.delete(self._ckpt_key(self.node_id))
 
@@ -148,11 +152,15 @@ class ElasticManager:
         return min(steps) if steps else None
 
     def done_members(self):
+        if self.store is None:
+            return set()
         return {k[len('done_'):] for k in self.store.keys('done_')}
 
     def live_members(self):
         """Sorted node ids with a progressing heartbeat (deterministic
         ranks)."""
+        if self.store is None:      # not registered: no membership view
+            return []
         now = time.time()
         out = []
         for key in self.store.keys('member_'):

@@ -433,6 +433,9 @@ class Model:
             return (jax.jit(step, donate_argnums=(0, 1, 2)),
                     jax.jit(accum_step, donate_argnums=(1, 2)),
                     jax.jit(apply_accum, donate_argnums=(0, 1)))
+        # the sync path re-reads params/opt_state after each step (metric
+        # hooks, host-side inspection), so donating would invalidate them
+        # pt-lint: disable=trace-missing-donate
         return jax.jit(step), jax.jit(accum_step), jax.jit(apply_accum)
 
     def _build_eval_step(self):

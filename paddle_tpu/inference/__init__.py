@@ -172,6 +172,10 @@ class Predictor:
                     xs = [x.astype(low)
                           if jnp.issubdtype(x.dtype, jnp.floating) else x
                           for x in xs]
+                # baking the frozen weights into the executable is the
+                # point here: XLA constant-folds them (int8 scales,
+                # lowered dtypes) and the predictor re-jits per shape
+                # pt-lint: disable=trace-closure-capture
                 out, _ = functional_call(layer, params, buffers, *xs)
                 return out
             fn = jax.jit(infer)

@@ -29,6 +29,10 @@ def get_lib():
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH):
+            # build-once-under-lock is intentional: concurrent callers must
+            # block until the shared library exists, and no device work can
+            # be in flight before the first loader is constructed
+            # pt-lint: disable=lock-blocking-call
             subprocess.run(['make', '-C', _NATIVE_DIR], check=True,
                            capture_output=True)
         lib = ctypes.CDLL(_LIB_PATH)

@@ -1163,12 +1163,19 @@ class GPTForCausalLM(Layer):
                 # compiled programs instead of retracing per length; extra
                 # steps may clamp at the last cache row, which only affects
                 # the discarded tail.
-                from ..tensor.random import next_key
                 loop = self._generate_loop(temperature, top_k, top_p)
                 n = n_cached - 1
                 bucket = 1 << (n - 1).bit_length() if n > 1 else 1
+                if temperature != 0:
+                    from ..tensor.random import next_key
+                    key = next_key()
+                else:
+                    # greedy never consumes randomness — a fixed key keeps
+                    # the global PRNG stream untouched so seeded runs are
+                    # reproducible regardless of generation length
+                    key = jax.random.PRNGKey(0)
                 new, cache = loop(params, first, jnp.int32(T0), cache,
-                                  next_key(), bucket)
+                                  key, bucket)
                 pieces.append(new[:, :n])
             toks = jnp.concatenate(pieces, axis=1)
         rest = max_new_tokens - n_cached

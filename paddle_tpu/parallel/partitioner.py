@@ -55,6 +55,12 @@ DEFAULT_RULES = (
     ('expert', 'ep'),
     ('layers', 'pp'),
     ('embed', None),
+    # replicated ON PURPOSE (explicit so the lint gate can tell a
+    # deliberate policy from a typo'd axis name): position embeddings are
+    # tiny and read by every rank; router/gate weights must be identical
+    # across expert shards or top-k dispatch diverges
+    ('positions', None),
+    ('router', None),
 )
 
 

@@ -132,6 +132,10 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, drop_rate=0.0, seed=None):
     the lse merge: each hop's kernel normalizer accumulates UNdropped
     probabilities, so the combined output is exactly
     dropout(global softmax) @ v."""
+    if drop_rate > 0.0 and seed is None:
+        # matches flash_attention: a silent seed default would make every
+        # hop (and every step) reuse the same dropout mask
+        raise ValueError('drop_rate > 0 requires seed')
     from ..ops.flash_attention import _flash_fwd
     sp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)

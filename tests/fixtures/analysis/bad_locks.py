@@ -1,0 +1,41 @@
+"""Lint fixture: every lock-order rule must fire on this file.
+
+NOT importable test code — scanned by tests/test_analysis.py as data.
+"""
+import threading
+import time
+
+import jax
+
+
+class Pair:
+    """a->b in one method, b->a in another: lock-cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:           # lock-cycle (a -> b)
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:           # lock-cycle (b -> a)
+                pass
+
+
+class Holder:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def sync_under_lock(self, x):
+        with self._mu:
+            jax.block_until_ready(x)    # lock-device-call
+            time.sleep(1.0)             # lock-blocking-call
+
+    def reacquire(self):
+        with self._mu:
+            with self._mu:              # lock-cycle (non-reentrant re-acquire)
+                pass

@@ -1,0 +1,22 @@
+"""Lint fixture: every sharding rule must fire on this file.
+
+Self-contained: validated against its OWN rules table (files that define
+one are checked without the canonical partitioner vocabulary).
+NOT importable test code — scanned by tests/test_analysis.py as data.
+"""
+
+FIXTURE_RULES = (
+    ('batch', 'dp'),
+    ('embed', None),
+    ('embed', 'mp'),        # shard-shadowed-rule (dead after the None stop)
+    ('heads', 'mp'),
+    ('heads', 'mp'),        # shard-shadowed-rule (identical duplicate)
+    ('mlp', 'mp'),
+)
+
+LOGICAL_AXES = {
+    'wte': ('vocabb', 'embed'),     # shard-unknown-axis (typo'd 'vocabb')
+    'blocks': {
+        'w1': ('heads', 'mlp'),     # shard-mesh-reuse (both resolve 'mp')
+    },
+}

@@ -1,0 +1,3 @@
+"""Lint fixture: unparseable on purpose (parse-error rule)."""
+def broken(:
+    pass

@@ -1,0 +1,39 @@
+"""Lint fixture: every trace-hygiene rule must fire on this file.
+
+NOT importable test code — scanned by tests/test_analysis.py as data.
+"""
+import time
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def hazards(x):
+    v = x.item()                    # trace-host-sync (.item readback)
+    h = np.asarray(x)               # trace-host-sync (numpy materialize)
+    f = float(x)                    # trace-host-sync (float() on traced arg)
+    t = time.time()                 # trace-nondeterminism (trace-time const)
+    r = random.random()             # trace-nondeterminism (stdlib random)
+    y = jnp.tanh(x)
+    if y > 0:                       # trace-host-branch (tracer -> bool)
+        y = y * 2
+    while jnp.any(y > 1):           # trace-host-branch (while on tracer)
+        y = y - 1
+    return y + v + h + f + t + r
+
+
+def make_step(params):
+    @jax.jit
+    def step(x):
+        return x @ params           # trace-closure-capture (baked weights)
+    return step
+
+
+def train(params, opt_state, x):
+    return params, opt_state
+
+
+train_step = jax.jit(train)         # trace-missing-donate (state threading)

@@ -1,0 +1,63 @@
+"""Lint fixture: idiomatic TPU-native code — ZERO findings expected.
+
+Exercises the patterns the heuristics must NOT flag: static shape/dtype
+branches, host predicates over device values, is-None checks, closures
+over tracers of the enclosing trace, donated state threading, and
+condition-variable waits on the held lock.
+NOT importable test code — scanned by tests/test_analysis.py as data.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+
+RULES = (
+    ('batch', 'dp'),
+    ('embed', None),
+)
+
+LOGICAL_AXES = {'w': ('batch', 'embed')}
+
+
+def _is_quantized(tree):
+    return isinstance(tree, dict) and 'scale' in tree
+
+
+@jax.jit
+def fine(x, mask=None):
+    if x.ndim == 2:                     # static: shape branch
+        x = x[None]
+    if mask is not None:                # static: None check
+        x = x * mask
+    k = jnp.dtype(x.dtype)              # static producer, not a tracer
+    y = jnp.tanh(x)
+    if _is_quantized({'scale': 1}):     # host predicate -> static bool
+        y = y * 2
+    return y, k
+
+
+def make_train(opt_apply):
+    def loss_fn(params, batch):
+        return jnp.sum(params['w'] @ batch)
+
+    def step(params, opt_state, batch):
+        # closure over `params`/`batch` here is fine: they are tracers of
+        # THIS trace, not baked constants
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch))(params)
+        return opt_apply(params, grads, opt_state)
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+class Queue:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()         # waiting on the HELD lock: fine
+            return self._items.pop()

@@ -1,0 +1,29 @@
+"""Lint fixture: every hazard here carries a suppressing pragma — the
+run must report ZERO findings for this file.
+NOT importable test code — scanned by tests/test_analysis.py as data.
+"""
+import time
+import threading
+
+import jax
+
+
+@jax.jit
+def acknowledged(x):
+    v = x.item()        # pt-lint: disable=trace-host-sync
+    # pt-lint: disable=trace-nondeterminism
+    t = time.time()
+    return v + t
+
+
+_mu = threading.Lock()
+
+
+def slow_but_deliberate():
+    with _mu:
+        # pt-lint: disable=lock-blocking-call
+        time.sleep(0.5)
+
+
+def everything_off(x):
+    return x            # pt-lint: disable=all

@@ -432,3 +432,25 @@ def test_distributed_utils_failure_propagates(tmp_path):
             if not dutils.watch_local_trainers(procs, 1):
                 raise AssertionError('trainer exited 3 but no error raised')
             time.sleep(0.2)
+
+
+def test_elastic_manager_safe_before_register(tmp_path):
+    """Every membership query/teardown is a no-op before register():
+    launcher error paths call deregister()/mark_done() on managers that
+    never connected (regression: AttributeError on self.store=None)."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    m = ElasticManager(str(tmp_path), node_id='aa', heartbeat_interval=0.1,
+                       min_nodes=1, max_nodes=2)
+    assert m.store is None
+    assert m.live_members() == []
+    assert m.done_members() == set()
+    m.mark_done()                 # must not raise
+    m.deregister()                # must not raise, stops the (unstarted) beat
+    # the same instance can still register and work normally afterwards
+    m2 = ElasticManager(str(tmp_path), node_id='bb',
+                        heartbeat_interval=0.1, min_nodes=1,
+                        max_nodes=2).register()
+    try:
+        assert 'bb' in m2.live_members()
+    finally:
+        m2.deregister()

@@ -396,3 +396,21 @@ def test_seed_folds_do_not_alias_coordinates():
         a = m0[2:-2, 2:-2]
         b = np.roll(m1, dq, axis=0)[2:-2, 2:-2]
         assert (a == b).mean() < 0.8, dq
+
+
+def test_ring_dropout_without_seed_is_rejected():
+    """drop_rate > 0 with no seed must raise, matching flash_attention: a
+    silent seed default would replay one dropout mask every hop and step
+    (regression: the ring path used to default seed to 0)."""
+    sp = 2
+    B, S, H, D = 1, 512 * sp, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.float32)
+    mesh = _mesh(sp)
+    spec = P(None, 'sp', None, None)
+    fn = shard_map(
+        partial(ra.ring_flash_attention, axis_name='sp', causal=True,
+                drop_rate=0.5),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    with pytest.raises(ValueError, match='requires seed'):
+        fn(q, q, q)

@@ -10,7 +10,7 @@ TPU_SESSION_NOTES.md: block_until_ready is a no-op on the axon platform):
   opt         optimizer apply alone (precomputed grads)
   flash       flash attention fwd / fwd+bwd at model shapes, x layers
   gemm        sustained bf16 GEMM ceiling (sanity: how close is the chip
-              to its 197 TFLOP/s paper number on a pure matmul)
+              to its datasheet peak — see _detect_peak — on a pure matmul)
 
 Run in a bounded subprocess:  timeout 900 python tools/tpu_breakdown.py
 """
@@ -37,6 +37,28 @@ from functools import partial
 
 import paddle_tpu as paddle
 from paddle_tpu.models import gpt
+
+
+def _detect_peak():
+    """Per-chip bf16 peak for the MFU denominators. Single source of
+    truth is bench.PEAK_FLOPS: the PALLAS_AXON_TPU_GEN env override wins
+    (bench._peak_flops), then the attached device's device_kind; the
+    paper chip (v5e, 197 TFLOP/s) is the fallback."""
+    from bench import PEAK_FLOPS, _peak_flops
+    dev = jax.devices()[0]
+    peak, known = _peak_flops(dev.platform)
+    if known:
+        return peak
+    kind = dev.device_kind.lower()
+    if 'v6' in kind:
+        return PEAK_FLOPS['v6e']
+    if 'v5e' in kind or 'lite' in kind:
+        return PEAK_FLOPS['v5e']
+    if 'v5' in kind:                      # v5p / bare 'TPU v5'
+        return PEAK_FLOPS['v5p']
+    if 'v4' in kind:
+        return PEAK_FLOPS['v4']
+    return PEAK_FLOPS['v5e']
 
 BATCH, SEQ = 8, 1024
 CFG = gpt.GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
@@ -68,6 +90,7 @@ def main():
     opt_state = opt.functional_init(params)
     toks = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, 32768)
     lr = jnp.asarray(2e-4)
+    peak = _detect_peak()
     res = {'n_params': n_params}
 
     def emit(k, v):
@@ -83,7 +106,7 @@ def main():
     dt = timeit(lambda: jstep(params, opt_state, key, lr, toks, toks))
     emit('full_ms', dt * 1e3)
     emit('tokens_per_sec', BATCH * SEQ / dt)
-    emit('mfu', 6.0 * n_params * res['tokens_per_sec'] / 197e12)
+    emit('mfu', 6.0 * n_params * res['tokens_per_sec'] / peak)
 
     # grad only
     jgrad = jax.jit(lambda p, t, y: jax.value_and_grad(gpt.loss_fn)(p, t, y, CFG))
@@ -142,7 +165,7 @@ def main():
         dt = timeit(lambda: jb(bparams, bstate, lr, toks), iters=5)
         emit('b13_full_ms', dt * 1e3)
         emit('b13_tokens_per_sec', BATCH * SEQ / dt)
-        emit('b13_mfu', 6.0 * bn * res['b13_tokens_per_sec'] / 197e12)
+        emit('b13_mfu', 6.0 * bn * res['b13_tokens_per_sec'] / peak)
         jbh = jax.jit(lambda p, t: gpt.forward_hidden(p, t, big))
         emit('b13_hidden_ms', timeit(lambda: jbh(bparams, toks),
                                      iters=5) * 1e3)
