@@ -631,6 +631,21 @@ def _child_telemetry():
     print(json.dumps(telemetry_check.run_check()))
 
 
+def _child_fleet():
+    """Fleet failover/autoscale gate row: tools/fleet_drill.py in a fresh
+    subprocess — kill-one-replica-mid-stream must lose zero requests and
+    duplicate zero stream tokens (byte-identity vs a single-engine
+    reference), keep the failover-wave p99 under 5x the healthy wave,
+    and autoscale up from the warm template with zero retraces. The
+    parent banks the fleet_* columns."""
+    _arm_watchdog(900)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import fleet_drill
+    print(json.dumps(fleet_drill.run_drill()))
+
+
 def _child_reqtrace_overhead():
     """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
     GenerationEngine with the telemetry plane attached, run by the parent
@@ -1178,6 +1193,21 @@ def main(fast=False):
         else:
             print(f'telemetry check failed: {tcnote}', file=sys.stderr)
 
+        # fleet drill gate: kill-mid-stream failover with zero lost
+        # requests / zero duplicate tokens, bounded blast radius, and a
+        # warm (zero-retrace) autoscale-up (fresh process)
+        fd, fdnote = _run_child(['--child-fleet'], 900,
+                                env={'BENCH_CHILD_TIMEOUT': '900'})
+        if fd is not None:
+            out['fleet_drill_ok'] = bool(fd.get('ok'))
+            out['fleet_lost_requests'] = fd.get('lost_requests')
+            out['fleet_dup_tokens'] = fd.get('dup_tokens')
+            out['fleet_failover_p99_ratio'] = fd.get('p99_ratio')
+            out['fleet_scale_up_ms'] = fd.get('scale_up_ms')
+            out['fleet_scale_up_traces'] = fd.get('scale_up_traces')
+        else:
+            print(f'fleet drill failed: {fdnote}', file=sys.stderr)
+
         # request-tracing overhead A/B on the decode rung: flight recorder
         # + telemetry server enabled vs hard-disabled; budget is <5%
         rt_res = {}
@@ -1307,6 +1337,8 @@ if __name__ == '__main__':
         _child_obs_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-telemetry':
         _child_telemetry()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-fleet':
+        _child_fleet()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

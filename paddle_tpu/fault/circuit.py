@@ -4,6 +4,18 @@ closed --(failure_threshold consecutive failures)--> open
 open   --(recovery_timeout elapsed)-->               half_open
 half_open --success--> closed   |   --failure--> open (timer restarts)
 
+Half-open admits at most ONE probe *in flight* at a time: when the
+recovery timeout elapses, exactly one queued caller is elected to test
+the dependency and every other caller keeps getting CircuitOpenError
+until that probe resolves — a half-open transition must never translate
+a backlog of waiting callers into a thundering herd against a replica
+that is still sick. ``half_open_max_calls`` bounds how many *sequential*
+trial calls one half-open period may spend before the verdict.
+
+Every state change increments ``fault.breaker_transition{from,to}`` (per
+breaker label) — the per-replica breaker-state telemetry the serving
+fleet router builds its failover accounting on.
+
 The clock is injectable so state transitions are deterministic in tests.
 """
 import itertools
@@ -35,6 +47,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = None
         self._trial_calls = 0
+        self._probe_inflight = False
         self.labels = {'breaker': f'b{next(CircuitBreaker._seq)}'}
         self._publish_state()
 
@@ -52,6 +65,9 @@ class CircuitBreaker:
             self._publish_state()
             _obs.record_event('fault.circuit_transition',
                               frm=old, to=new_state, **self.labels)
+            _obs.counter('fault.breaker_transition',
+                         {'from': old, 'to': new_state,
+                          **self.labels}).inc()
             if new_state == OPEN:
                 _obs.counter('fault.circuit_opened').inc()
 
@@ -67,10 +83,12 @@ class CircuitBreaker:
                 self._clock() - self._opened_at >= self.recovery_timeout:
             self._transition(HALF_OPEN)
             self._trial_calls = 0
+            self._probe_inflight = False
 
     def _open(self):
         self._opened_at = self._clock()
         self._failures = 0
+        self._probe_inflight = False
         self._transition(OPEN)
 
     def reset(self):
@@ -78,31 +96,41 @@ class CircuitBreaker:
             self._failures = 0
             self._opened_at = None
             self._trial_calls = 0
+            self._probe_inflight = False
             self._transition(CLOSED)
 
     # ---- accounting -----------------------------------------------------
     def allow(self):
-        """Reserve permission for one call. In half-open only
-        ``half_open_max_calls`` trial calls get through."""
+        """Reserve permission for one call. In half-open, exactly one probe
+        may be in flight at a time (concurrent callers queued behind the
+        recovery timeout must not re-hammer a sick dependency), and at most
+        ``half_open_max_calls`` sequential trials run per half-open period.
+        A granted half-open permit MUST be resolved with record_success()
+        or record_failure() — ``call()`` does this automatically."""
         with self._lock:
             self._maybe_half_open()
             if self._state == CLOSED:
                 return True
             if self._state == HALF_OPEN:
+                if self._probe_inflight:
+                    return False
                 if self._trial_calls < self.half_open_max_calls:
                     self._trial_calls += 1
+                    self._probe_inflight = True
                     return True
                 return False
             return False
 
     def record_success(self):
         with self._lock:
+            self._probe_inflight = False
             self._failures = 0
             if self._state in (HALF_OPEN, OPEN):
                 self.reset()
 
     def record_failure(self):
         with self._lock:
+            self._probe_inflight = False
             self._maybe_half_open()
             if self._state == HALF_OPEN:
                 self._open()

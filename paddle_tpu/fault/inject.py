@@ -12,9 +12,14 @@ before the payload / manifest os.replace), ``dataloader.step`` (per batch),
 ``store.heartbeat`` (elastic membership beat), ``serving.dispatch``
 (serving.InferenceEngine, entry of every batched device call — inside the
 engine's CircuitBreaker, so armed faults exercise the breaker-opening
-path), and ``warmup.cache`` (warmup.enable_persistent_cache, inside the
+path), ``warmup.cache`` (warmup.enable_persistent_cache, inside the
 retried directory probe — armed faults exercise the fall-back-to-cold-
-compiles path).
+compiles path), ``fleet.route`` (serving.FleetRouter's routing decision;
+an armed fault parks the request for control-loop retry rather than
+losing it), and ``fleet.failover`` (the fleet health sweep; an armed
+fault kills one replica via ``shutdown(drain=False)``, driving the full
+resubmit-without-loss failover path — the hook tools/fleet_drill.py is
+built on).
 
 When no spec is armed, ``inject()`` is a single falsy-dict check — zero cost
 on hot paths.

@@ -208,12 +208,15 @@ class FlightRecorder:
         return rec
 
     def _notable(self, rec):
-        """Retained preferentially: failed, slow, or evicted requests."""
+        """Retained preferentially: failed, slow, evicted, or failed-over /
+        hedged requests (a request that survived a replica death is exactly
+        the one worth a post-mortem even though its outcome reads ok)."""
         if rec.outcome not in _OK_OUTCOMES:
             return True
         if rec.duration_ms is not None and rec.duration_ms >= self.slow_ms:
             return True
-        return any(e.get('ev') == 'evict' for e in rec.timeline)
+        return any(e.get('ev') in ('evict', 'failover', 'hedge')
+                   for e in rec.timeline)
 
     def _complete(self, rec):
         with self._lock:

@@ -124,6 +124,19 @@ class Watcher:
             self._rules.append(r)
         return r
 
+    def remove_rule(self, name):
+        """Deregister a rule by name (a fleet autoscaler retires a
+        replica's queue-wait rule when the replica is decommissioned).
+        Clears the rule's ``slo.firing`` gauge if it was firing; returns
+        the removed Rule or None."""
+        with self._lock:
+            r = next((x for x in self._rules if x.name == name), None)
+            if r is not None:
+                self._rules.remove(r)
+        if r is not None and r.state == 'firing':
+            _registry().gauge('slo.firing', {'rule': r.name}).set(0)
+        return r
+
     @property
     def rules(self):
         with self._lock:
@@ -221,6 +234,9 @@ class _NullWatcher:
 
     def add_rule(self, r):
         return r
+
+    def remove_rule(self, name):
+        return None
 
     def states(self):
         return {}

@@ -17,6 +17,12 @@ Ragged-Paged-Attention / TPP serving discipline.
 Robustness: bounded admission queue (``QueueFullError``), per-request
 deadlines (``DeadlineExceededError``, a fault.RetryError), a CircuitBreaker
 around the device call, and the ``serving.dispatch`` chaos point.
+
+Fleet serving (``fleet.py``): a ``ReplicaSet`` of N engines behind a
+``FleetRouter`` front door — health-gated least-loaded routing, failover
+that loses no request and duplicates no stream token, load shedding with
+a ``retry_after_ms`` hint, SLO-driven autoscaling from a warm template,
+and graceful drain for zero-drop rolling restarts.
 """
 from .bucketing import (bucket_for, bucket_sizes, input_signature,  # noqa: F401
                         pad_rows)
@@ -26,10 +32,13 @@ from .errors import (DeadlineExceededError, EngineClosedError,  # noqa: F401
 from .metrics import ServingStats  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .generation import GenerationEngine, GenerationFuture  # noqa: F401
+from .fleet import (Autoscaler, FleetRouter, Replica,  # noqa: F401
+                    ReplicaSet)
 
 __all__ = [
     'InferenceEngine', 'ServingStats', 'BucketCompileCache',
     'GenerationEngine', 'GenerationFuture',
+    'ReplicaSet', 'FleetRouter', 'Autoscaler', 'Replica',
     'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',
     'QueueFullError', 'DeadlineExceededError', 'EngineClosedError',
 ]

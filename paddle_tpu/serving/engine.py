@@ -40,6 +40,10 @@ ENV_MAX_BATCH = 'PADDLE_TPU_SERVE_MAX_BATCH'
 ENV_MAX_DELAY = 'PADDLE_TPU_SERVE_MAX_DELAY_MS'
 
 _LOW_DTYPES = {'bfloat16': jnp.bfloat16, 'float16': jnp.float16}
+
+# sentinel distinguishing "deadline not supplied" from "no deadline" on
+# fleet resubmission (see submit()'s underscore params)
+_UNSET = object()
 # int8_wo: weights stored int8 (per-output-channel scales), dequantized
 # in-trace inside each bucket's executable — activations stay full width
 _PRECISIONS = ('float32', 'bfloat16', 'float16', 'int8_wo')
@@ -323,18 +327,31 @@ class InferenceEngine:
         return False
 
     # ---- admission -------------------------------------------------------
-    def submit(self, *inputs, deadline_ms=None):
+    def submit(self, *inputs, deadline_ms=None,
+               _record=None, _enqueue_t=None, _deadline_t=_UNSET):
+        """Enqueue one request. The underscore params are the fleet
+        router's resubmission hooks: a failed-over request keeps its
+        original ``RequestRecord``, submit-time enqueue timestamp, and
+        absolute deadline so queue-wait accounting and deadline
+        enforcement stay truthful across replicas."""
         arrays, n, sig = normalize_request(inputs)
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.default_deadline_ms)
         now = self._clock()
-        deadline_t = (now + deadline_ms / 1e3
-                      if deadline_ms is not None else None)
+        enqueue_t = _enqueue_t if _enqueue_t is not None else now
+        if _deadline_t is not _UNSET:
+            deadline_t = _deadline_t
+        else:
+            deadline_t = (now + deadline_ms / 1e3
+                          if deadline_ms is not None else None)
         future = Future()
         # request-scoped trace: one record per submit(), shared by every
         # chunk of a split request (NULL_RECORD when obs is disabled)
-        rec = _obs.start_request(
-            'serve', engine=self._stats.labels['engine'], rows=n)
+        if _record is not None:
+            rec = _record
+        else:
+            rec = _obs.start_request(
+                'serve', engine=self._stats.labels['engine'], rows=n)
         future.request_id = rec.rid
         max_b = self.max_batch_size
         if n <= max_b:
@@ -358,7 +375,8 @@ class InferenceEngine:
                 rec.note('enqueue', depth=depth, chunks=len(chunks))
                 for arrs, fut in chunks:
                     self._queues.push(
-                        Request(arrs, sig, fut, now, deadline_t, rec=rec))
+                        Request(arrs, sig, fut, enqueue_t, deadline_t,
+                                rec=rec))
                 # split requests are accounted per admitted chunk so
                 # submitted/completed/occupancy all measure the same unit
                 self._stats.note_submitted(len(chunks))

@@ -10,14 +10,22 @@ from ..fault.errors import RetryError
 class QueueFullError(RuntimeError):
     """Admission control rejected a request: the engine's bounded queue is
     at capacity. Explicit backpressure — the caller decides whether to shed,
-    retry with backoff, or block; the engine never buffers unboundedly."""
+    retry with backoff, or block; the engine never buffers unboundedly.
 
-    def __init__(self, capacity, depth):
-        super().__init__(
-            f'serving queue full ({depth}/{capacity} pending); '
-            f'request rejected by admission control')
+    ``retry_after_ms`` (optional) is the shedder's estimate of when
+    capacity will exist again — the fleet router populates it from the
+    observed queue-wait distribution when *every* replica is saturated, so
+    clients can back off for a useful interval instead of guessing."""
+
+    def __init__(self, capacity, depth, retry_after_ms=None):
+        msg = (f'serving queue full ({depth}/{capacity} pending); '
+               f'request rejected by admission control')
+        if retry_after_ms is not None:
+            msg += f'; retry after ~{retry_after_ms:.0f}ms'
+        super().__init__(msg)
         self.capacity = capacity
         self.depth = depth
+        self.retry_after_ms = retry_after_ms
 
 
 class DeadlineExceededError(RetryError):

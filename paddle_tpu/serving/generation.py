@@ -60,6 +60,10 @@ ENV_PAGE_SIZE = 'PADDLE_TPU_GEN_PAGE_SIZE'
 
 _HIST_WINDOW = 4096
 
+# sentinel distinguishing "deadline not supplied" from "no deadline": the
+# fleet router must be able to resubmit a deadline-free request as such
+_UNSET = object()
+
 
 def _env_int(name, default):
     try:
@@ -79,18 +83,41 @@ class GenerationFuture:
         self._tokens = []
         self._done = False
         self._exc = None
+        self._listeners = []
 
     # ---- engine-internal ------------------------------------------------
     def _count(self):
         with self._cv:
             return len(self._tokens)
 
+    def _subscribe(self, fn):
+        """Register ``fn(kind, *args)`` invoked OUTSIDE the future's lock:
+        ``('token', idx, tok)`` per emission and ``('finish', exc)`` once.
+        Tokens already emitted are replayed so a late subscriber (a fleet
+        router attaching to a resubmitted request) misses nothing. Callers
+        must tolerate out-of-order delivery across the replay/live seam —
+        the index identifies each token's position."""
+        with self._cv:
+            self._listeners.append(fn)
+            replay = list(enumerate(self._tokens))
+            done, exc = self._done, self._exc
+        for i, t in replay:
+            fn('token', i, t)
+        if done:
+            fn('finish', exc)
+
     def _append(self, tok):
         with self._cv:
             if self._done:
                 return
             self._tokens.append(int(tok))
+            idx = len(self._tokens) - 1
+            listeners = list(self._listeners)
             self._cv.notify_all()
+        # listeners run outside the lock: they may touch other futures /
+        # router queues whose locks must never nest inside this one
+        for fn in listeners:
+            fn('token', idx, int(tok))
 
     def _finish(self, exc=None):
         with self._cv:
@@ -98,8 +125,11 @@ class GenerationFuture:
                 return False
             self._done = True
             self._exc = exc
+            listeners = list(self._listeners)
             self._cv.notify_all()
-            return True
+        for fn in listeners:
+            fn('finish', exc)
+        return True
 
     # ---- caller API -----------------------------------------------------
     def done(self):
@@ -332,7 +362,13 @@ class GenerationEngine:
         self._c['tokens'] = mk_c('gen.tokens')
         self._h = {'prefill': mk_h('gen.prefill_ms'),
                    'step': mk_h('gen.decode_step_ms'),
-                   'ttft': mk_h('gen.ttft_ms')}
+                   'ttft': mk_h('gen.ttft_ms'),
+                   # same series the batch engines emit, labelled gN — the
+                   # fleet autoscaler's per-replica p99 rules key on it.
+                   # Observed at admit from the ORIGINAL enqueue_t, which
+                   # requeue-after-eviction preserves: a preempted request's
+                   # wait is never under-reported.
+                   'queue_wait': mk_h('serve.queue_wait_ms')}
         self._g = {'occupancy': mk_g('gen.slot_occupancy'),
                    'pages': mk_g('gen.page_utilization')}
 
@@ -472,11 +508,19 @@ class GenerationEngine:
         return False
 
     # ---- admission -------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=32, deadline_ms=None, seed=0):
+    def submit(self, prompt, max_new_tokens=32, deadline_ms=None, seed=0,
+               *, _record=None, _enqueue_t=None, _deadline_t=_UNSET):
         """Enqueue one sequence. ``prompt`` is a 1-D token id sequence of
         length 1..prefill_width; returns a ``GenerationFuture``. Tokens
         stop at ``eos_id`` (emitted), ``max_new_tokens``, or the context
-        window (a prompt of exactly max_seq_len still yields one token)."""
+        window (a prompt of exactly max_seq_len still yields one token).
+
+        The underscore params are the fleet router's resubmission hooks:
+        a failed-over request keeps its original ``RequestRecord``,
+        submit-time enqueue timestamp, and absolute deadline so queue-wait
+        SLO accounting and deadline enforcement stay truthful across
+        replicas (timestamps must come from this engine's clock domain —
+        ``time.monotonic`` unless a test injected one)."""
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         t0 = int(arr.size)
         if not 1 <= t0 <= self.prefill_width:
@@ -492,15 +536,22 @@ class GenerationEngine:
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.default_deadline_ms)
         now = self._clock()
-        deadline_t = (now + deadline_ms / 1e3
-                      if deadline_ms is not None else None)
+        enqueue_t = _enqueue_t if _enqueue_t is not None else now
+        if _deadline_t is not _UNSET:
+            deadline_t = _deadline_t
+        else:
+            deadline_t = (now + deadline_ms / 1e3
+                          if deadline_ms is not None else None)
         fut = GenerationFuture()
         # request-scoped trace: minted here, rides the request across the
         # submit -> scheduler thread boundary (NULL_RECORD when disabled)
-        rec = _obs.start_request('gen', engine=self.labels['engine'],
-                                 prompt_len=t0, max_new=eff)
+        if _record is not None:
+            rec = _record
+        else:
+            rec = _obs.start_request('gen', engine=self.labels['engine'],
+                                     prompt_len=t0, max_new=eff)
         fut.request_id = rec.rid
-        req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, now,
+        req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, enqueue_t,
                        deadline_t, rec=rec)
         try:
             with self._cv:
@@ -589,7 +640,10 @@ class GenerationEngine:
             self._queue.popleft()
             table = np.zeros((self.p_max,), np.int32)
             table[:need] = pages
-            req.rec.note('admit', slot=free_idx, pages=need)
+            waited_ms = max(0.0, (now - req.enqueue_t) * 1e3)
+            self._h['queue_wait'].observe(waited_ms)
+            req.rec.note('admit', slot=free_idx, pages=need,
+                         waited_ms=round(waited_ms, 3))
             self._slots[free_idx] = _Slot(req, table, self._admit_seq)
             self._admit_seq += 1
             out.append(free_idx)

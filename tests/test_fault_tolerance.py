@@ -19,6 +19,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import fault
+from paddle_tpu import observability as obs
 from paddle_tpu.fault import (CheckpointCorruptError, CircuitBreaker,
                               CircuitOpenError, InjectedFault, RetryError,
                               UnsafePayloadError, retry)
@@ -176,6 +177,52 @@ def test_circuit_half_open_limits_trial_calls():
     clk.now += 1.0
     assert cb.allow() is True              # the one trial slot
     assert cb.allow() is False             # concurrent probes refused
+
+
+def test_circuit_half_open_single_probe_in_flight():
+    # even with trial budget left, only ONE probe may be in flight: a
+    # backlog of callers queued behind the recovery timeout must not
+    # become a thundering herd against a still-sick dependency
+    clk = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, recovery_timeout=1.0,
+                        half_open_max_calls=3, clock=clk.time)
+    cb.record_failure()
+    clk.now += 1.0
+    assert cb.allow() is True              # probe elected
+    assert cb.allow() is False             # budget says 3, in-flight says no
+    assert cb.allow() is False
+    cb.record_failure()                    # probe resolves: still down
+    assert cb.state == 'open'
+    clk.now += 1.0                         # next half-open period
+    assert cb.allow() is True              # exactly one re-elected probe
+    assert cb.allow() is False
+    cb.record_success()                    # dependency recovered
+    assert cb.state == 'closed'
+    assert cb.allow() is True              # closed: no probe gating
+
+
+def test_breaker_transition_counter_tracks_state_changes():
+    clk = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, recovery_timeout=1.0,
+                        clock=clk.time)
+
+    def transitions(frm, to):
+        c = obs.find('fault.breaker_transition',
+                     {'from': frm, 'to': to, **cb.labels})
+        return c.value if c is not None else 0
+
+    cb.record_failure()                    # closed -> open
+    assert transitions('closed', 'open') == 1
+    clk.now += 1.0
+    assert cb.state == 'half_open'         # open -> half_open
+    assert transitions('open', 'half_open') == 1
+    cb.record_failure()                    # half_open -> open
+    assert transitions('half_open', 'open') == 1
+    clk.now += 1.0
+    assert cb.state == 'half_open'
+    assert transitions('open', 'half_open') == 2
+    cb.record_success()                    # half_open -> closed
+    assert transitions('half_open', 'closed') == 1
 
 
 # ---- fault injection -----------------------------------------------------

@@ -385,6 +385,49 @@ def test_queue_full_and_deadline(params):
     assert isinstance(fut.exception(timeout=10), DeadlineExceededError)
 
 
+def test_requeue_preserves_enqueue_time_for_slo_accounting(params):
+    # an evicted request is requeued as the SAME _Request object: its
+    # submit-time enqueue timestamp survives, so the queue-wait recorded
+    # at re-admission keeps growing instead of resetting — truthful SLO
+    # accounting across evictions (and, via the same hooks, failovers)
+    prompts = _prompts([9, 9], seed=23)
+    with _engine(params, num_pages=6) as eng:
+        futs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        [f.result(timeout=120) for f in futs]
+        assert eng.stats()['evictions'] >= 1
+        label = eng.labels['engine']
+        recs = [obs.recorder().lookup(f.request_id) for f in futs]
+    evicted = next(r for r in recs
+                   if any(e['ev'] == 'evict' for e in r['timeline']))
+    admits = [e for e in evicted['timeline'] if e['ev'] == 'admit']
+    assert len(admits) >= 2, 'evicted request was never re-admitted'
+    waits = [e['waited_ms'] for e in admits]
+    assert waits == sorted(waits) and waits[-1] > waits[0]
+    # every admission feeds the serve.queue_wait histogram the fleet
+    # autoscaler and shed hint read
+    h = obs.find('serve.queue_wait_ms', {'engine': label})
+    assert h is not None and h.count >= len(admits)
+
+
+def test_resubmission_hooks_preserve_record_and_deadline(params):
+    import time as _time
+    eng = _engine(params, autostart=False)
+    p = _prompts([4])[0]
+    now = _time.monotonic()
+    rec = obs.start_request('gen', engine=eng.labels['engine'])
+    # a failed-over request arrives with its ORIGINAL submit timestamp and
+    # absolute deadline — both already in the past here
+    fut = eng.submit(p, max_new_tokens=2, _record=rec,
+                     _enqueue_t=now - 5.0, _deadline_t=now - 1.0)
+    assert fut.request_id == rec.rid       # no new record minted
+    eng.shutdown()                          # inline drain: expires it
+    err = fut.exception(timeout=10)
+    assert isinstance(err, DeadlineExceededError)
+    # waited/limit are measured from the original enqueue, not this submit
+    assert err.waited_ms >= 4900.0
+    assert 3900.0 <= err.deadline_ms <= 4100.0
+
+
 def test_prompt_validation(params):
     eng = _engine(params, autostart=False)
     try:

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Fleet kill-mid-stream drill: the ISSUE-12 acceptance gate, runnable
+anywhere (CPU-safe, fresh subprocess).
+
+One child process builds a two-replica generation fleet behind a
+``FleetRouter`` and drives three phases:
+
+  1. **healthy wave** — N streams against the warm fleet; per-request
+     end-to-end latencies give ``healthy_p99_ms``;
+  2. **kill mid-stream** — the same N prompts again, then the
+     ``fleet.failover`` chaos point is armed (probability 1.0, one
+     fault): the health sweep SIGKILL-simulates one replica while its
+     streams are mid-decode. Every stream must still complete
+     byte-identical to a single-engine reference (``lost_requests``)
+     and no token index may be emitted twice (``dup_tokens`` — the
+     router's mirror dedups the survivor's seeded regeneration);
+     latencies give ``failover_p99_ms`` and the blast-radius ratio;
+  3. **autoscale-up** — a one-replica fleet with an Autoscaler whose
+     ``serve.queue_wait`` p99 SLO is set to fire under a 12-request
+     burst: a second replica must spawn from the warm template and
+     report ZERO retraces (``scale_up_traces``), with the spawn wall
+     time banked as ``scale_up_ms``.
+
+Prints ONE json line::
+
+  {"lost_requests": 0, "dup_tokens": 0, "replicas_killed": 1,
+   "healthy_p99_ms": 12.3, "failover_p99_ms": 41.0, "p99_ratio": 3.3,
+   "scaled_up": true, "scale_up_traces": 0, "scale_up_ms": 18.7,
+   "ok": true}
+
+``ok`` requires: zero lost requests, zero duplicate tokens, exactly one
+replica killed, p99_ratio < 5, and a warm (zero-retrace) scale-up.
+Exit code 0 iff ok. ``run_drill()`` is importable from bench.py.
+
+Usage: python tools/fleet_drill.py [--requests N] [--tokens T]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+P99_RATIO_LIMIT = 5.0
+
+
+def _p99(samples):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * 0.99))] if s else 0.0
+
+
+def _child(n_requests, n_tokens):
+    import numpy as np
+    import jax
+    from paddle_tpu import fault
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (Autoscaler, FleetRouter,
+                                    GenerationEngine, ReplicaSet)
+
+    cfg = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(1, cfg.vocab_size, size=4 + i % 5)
+               for i in range(n_requests)]
+
+    def engine(**kw):
+        kw.setdefault('num_slots', 2)
+        kw.setdefault('page_size', 8)
+        kw.setdefault('prefill_width', 16)
+        kw.setdefault('queue_capacity', 64)
+        return GenerationEngine(params, cfg, **kw)
+
+    # single-engine reference: the byte-identity baseline
+    ref_eng = engine()
+    want = [ref_eng.submit(p, max_new_tokens=n_tokens, seed=i)
+            .result(timeout=300) for i, p in enumerate(prompts)]
+    ref_eng.shutdown()
+
+    out = {}
+
+    def wave(router, seed_base):
+        """Submit every prompt, stream each to completion; returns
+        (streams, per-request end-to-end latencies in ms)."""
+        t0 = {}
+        futs = []
+        for i, p in enumerate(prompts):
+            t0[i] = time.perf_counter()
+            futs.append(router.submit(p, max_new_tokens=n_tokens,
+                                      seed=seed_base + i))
+        streams, lats = [], []
+        for i, f in enumerate(futs):
+            try:
+                streams.append(list(f.stream(timeout=300)))
+            except Exception:
+                streams.append(None)
+            lats.append((time.perf_counter() - t0[i]) * 1e3)
+        return streams, lats
+
+    # phase 1+2 fleet: two directly-warmed replicas
+    engines = [engine(), engine()]
+    for e in engines:
+        e.submit(np.array([3, 1, 4]), max_new_tokens=2,
+                 seed=999).result(timeout=300)
+    rset = ReplicaSet(replicas=engines)
+    router = FleetRouter(rset, tick_s=0.005)
+
+    healthy, healthy_lats = wave(router, seed_base=0)
+    out['healthy_p99_ms'] = round(_p99(healthy_lats), 3)
+
+    # phase 2: kill one replica while streams are mid-decode. The seeds
+    # match the reference wave (seed_base=0), so byte-identity must hold
+    # across the failover resubmission.
+    t_arm = []
+    futs = []
+    for i, p in enumerate(prompts):
+        t_arm.append(time.perf_counter())
+        futs.append(router.submit(p, max_new_tokens=n_tokens, seed=i))
+    time.sleep(0.05)
+    fault.configure('fleet.failover:1.0', seed=7, max_faults=1)
+    try:
+        failover, failover_lats = [], []
+        for i, f in enumerate(futs):
+            try:
+                failover.append(list(f.stream(timeout=300)))
+            except Exception:
+                failover.append(None)
+            failover_lats.append((time.perf_counter() - t_arm[i]) * 1e3)
+    finally:
+        fault.configure(None)
+    out['failover_p99_ms'] = round(_p99(failover_lats), 3)
+    out['p99_ratio'] = round(
+        out['failover_p99_ms'] / max(out['healthy_p99_ms'], 1e-9), 3)
+
+    lost = dups = 0
+    for got, ref in zip(failover, want):
+        if got is None or got[:len(ref)] != ref:
+            lost += 1
+        elif len(got) > len(ref):
+            dups += len(got) - len(ref)
+    # the healthy wave must also have matched — fold it into the gate
+    lost += sum(1 for got, ref in zip(healthy, want) if got != ref)
+    out['lost_requests'] = lost
+    out['dup_tokens'] = dups
+    killed = obs.find('fleet.replicas_killed', {'fleet': rset.name})
+    out['replicas_killed'] = int(killed.value) if killed is not None else 0
+    router.close(drain=False)
+
+    # phase 3: autoscale-up from the warm template under a queue-wait
+    # SLO breach; the spawned replica must serve with zero retraces
+    rset2 = ReplicaSet(lambda: engine(num_slots=1), initial=1,
+                       min_replicas=1, max_replicas=2)
+    asc = Autoscaler(qwait_p99_ms=1.0, idle_s=30.0, cooldown_s=0.2,
+                     debounce=1)
+    router2 = FleetRouter(rset2, autoscaler=asc, tick_s=0.01)
+    futs = [router2.submit(p, max_new_tokens=n_tokens, seed=i)
+            for i, p in enumerate(prompts)]
+    spawned, deadline = None, time.time() + 120
+    while time.time() < deadline and spawned is None:
+        extra = rset2.snapshot()[1:]
+        spawned = extra[0] if extra else None
+        time.sleep(0.02)
+    for f in futs:
+        f.result(timeout=300)
+    out['scaled_up'] = spawned is not None
+    out['scale_up_traces'] = (int(spawned.engine.stats()['traces'])
+                              if spawned is not None else -1)
+    h = obs.find('fleet.scale_up_ms', {'fleet': rset2.name})
+    out['scale_up_ms'] = (round(h.percentile(50), 3)
+                          if h is not None and h.count else -1.0)
+    router2.close()
+
+    print(json.dumps(out))
+
+
+def run_drill(n_requests=8, n_tokens=24, timeout=900):
+    """Run the drill in a fresh subprocess; returns the summary dict with
+    the aggregate ``ok`` verdict (importable from bench.py and tests)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child',
+         '--requests', str(n_requests), '--tokens', str(n_tokens)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'fleet drill child failed:\n{proc.stdout}\n'
+                           f'{proc.stderr}')
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out['ok'] = bool(out['lost_requests'] == 0
+                     and out['dup_tokens'] == 0
+                     and out['replicas_killed'] == 1
+                     and out['p99_ratio'] < P99_RATIO_LIMIT
+                     and out['scaled_up']
+                     and out['scale_up_traces'] == 0)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--requests', type=int, default=8)
+    ap.add_argument('--tokens', type=int, default=24)
+    ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.requests, args.tokens)
+        return 0
+    result = run_drill(n_requests=args.requests, n_tokens=args.tokens)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
