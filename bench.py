@@ -646,6 +646,22 @@ def _child_fleet():
     print(json.dumps(fleet_drill.run_drill()))
 
 
+def _child_tenant():
+    """Multi-tenant hosting gate row: tools/tenant_drill.py in a fresh
+    subprocess — a 3-model ModelHost under a 2x mixed-lane overload must
+    keep interactive p99 within 3x the unloaded baseline while batch
+    sheds with retry_after_ms hints, refuse infeasible admissions under
+    the HBM watermark without stripping cold models, and evict/swap-in a
+    model mid-traffic with zero lost interactive requests and zero new
+    traces. The parent banks the tenant_* columns."""
+    _arm_watchdog(900)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import tenant_drill
+    print(json.dumps(tenant_drill.run_drill()))
+
+
 def _child_reqtrace_overhead():
     """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
     GenerationEngine with the telemetry plane attached, run by the parent
@@ -1208,6 +1224,21 @@ def main(fast=False):
         else:
             print(f'fleet drill failed: {fdnote}', file=sys.stderr)
 
+        # tenant drill gate: interactive p99 within 3x baseline under a
+        # 2x mixed-lane overload, hinted batch shedding, watermark-safe
+        # admission, and a zero-trace mid-traffic swap-in (fresh process)
+        td, tdnote = _run_child(['--child-tenant'], 900,
+                                env={'BENCH_CHILD_TIMEOUT': '900'})
+        if td is not None:
+            out['tenant_drill_ok'] = bool(td.get('ok'))
+            out['tenant_overload_p99_ratio'] = td.get('p99_ratio')
+            out['tenant_shed_count'] = td.get('shed_count')
+            out['tenant_swap_in_ms'] = td.get('swap_in_ms')
+            out['tenant_swap_in_traces'] = td.get('swap_in_traces')
+            out['tenant_lost_interactive'] = td.get('lost_interactive')
+        else:
+            print(f'tenant drill failed: {tdnote}', file=sys.stderr)
+
         # request-tracing overhead A/B on the decode rung: flight recorder
         # + telemetry server enabled vs hard-disabled; budget is <5%
         rt_res = {}
@@ -1339,6 +1370,8 @@ if __name__ == '__main__':
         _child_telemetry()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-fleet':
         _child_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-tenant':
+        _child_tenant()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

@@ -16,10 +16,14 @@ path), ``warmup.cache`` (warmup.enable_persistent_cache, inside the
 retried directory probe — armed faults exercise the fall-back-to-cold-
 compiles path), ``fleet.route`` (serving.FleetRouter's routing decision;
 an armed fault parks the request for control-loop retry rather than
-losing it), and ``fleet.failover`` (the fleet health sweep; an armed
+losing it), ``fleet.failover`` (the fleet health sweep; an armed
 fault kills one replica via ``shutdown(drain=False)``, driving the full
 resubmit-without-loss failover path — the hook tools/fleet_drill.py is
-built on).
+built on), ``host.admit`` (serving.ModelHost admission, before any side
+effect — an armed fault aborts the deploy/swap-in with accounting
+unchanged), and ``host.evict`` (ModelHost eviction — an armed fault
+aborts the eviction, leaving the victim live; an admission that needed
+the space fails without side effects).
 
 When no spec is armed, ``inject()`` is a single falsy-dict check — zero cost
 on hot paths.

@@ -865,11 +865,16 @@ class Model:
                     try:
                         pending.append(eng.submit(*arrs))
                         break
-                    except QueueFullError:
+                    except QueueFullError as e:
                         # other submitters (or split chunks) filled the
                         # queue: drain one of ours and retry
                         if pending:
                             _consume(pending.popleft())
+                        elif e.retry_after_ms:
+                            # a shedding engine/host advertised when
+                            # capacity should exist again — honor it
+                            # instead of hot-spinning on the admission gate
+                            time.sleep(e.retry_after_ms / 1e3)
                         else:
                             time.sleep(1e-3)
             while pending:

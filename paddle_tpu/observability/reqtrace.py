@@ -203,7 +203,12 @@ class FlightRecorder:
         with self._lock:
             self._active[rid] = rec
             n_active = len(self._active)
-        counter('request.started', {'kind': kind}).inc()
+        lbl = {'kind': kind}
+        if 'tenant' in attrs:
+            # per-tenant request accounting: a ModelHost threads the tenant
+            # through here so /metrics can attribute load per tenant
+            lbl['tenant'] = str(attrs['tenant'])
+        counter('request.started', lbl).inc()
         gauge('request.active').set(n_active)
         return rec
 
@@ -227,8 +232,10 @@ class FlightRecorder:
                                if not self._notable(r)), 0)
                 self._done.pop(victim)
             n_active = len(self._active)
-        counter('request.completed',
-                {'kind': rec.kind, 'outcome': rec.outcome or '?'}).inc()
+        lbl = {'kind': rec.kind, 'outcome': rec.outcome or '?'}
+        if 'tenant' in rec.attrs:
+            lbl['tenant'] = str(rec.attrs['tenant'])
+        counter('request.completed', lbl).inc()
         gauge('request.active').set(n_active)
 
     # ---- queries ---------------------------------------------------------
@@ -240,10 +247,12 @@ class FlightRecorder:
                 rec = next((r for r in self._done if r.rid == rid), None)
         return rec.to_dict() if rec is not None else None
 
-    def requests(self, outcome=None, rid=None, limit=None):
+    def requests(self, outcome=None, rid=None, limit=None, tenant=None):
         """Newest-first list of record dicts. ``outcome`` filters completed
         records ('ok', 'error', 'expired', 'rejected', or 'active' for the
-        in-flight set); ``rid`` selects one request."""
+        in-flight set); ``rid`` selects one request; ``tenant`` filters on
+        the ``tenant`` attr a ModelHost stamps onto every request it
+        routes (per-tenant blast-radius triage)."""
         if rid:
             found = self.lookup(rid)
             return [found] if found is not None else []
@@ -256,6 +265,8 @@ class FlightRecorder:
             recs = [r for r in done if r.outcome == outcome]
         else:
             recs = active + done
+        if tenant:
+            recs = [r for r in recs if r.attrs.get('tenant') == tenant]
         if limit is not None:
             recs = recs[:max(0, int(limit))]
         return [r.to_dict() for r in recs]
@@ -292,7 +303,7 @@ class _NullRecorder:
     def lookup(self, rid):
         return None
 
-    def requests(self, outcome=None, rid=None, limit=None):
+    def requests(self, outcome=None, rid=None, limit=None, tenant=None):
         return []
 
     def set_capacity(self, n):

@@ -149,7 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
         limit = q.get('limit')
         reqs = rec.requests(outcome=q.get('outcome') or None,
                             rid=q.get('id') or None,
-                            limit=int(limit) if limit else None)
+                            limit=int(limit) if limit else None,
+                            tenant=q.get('tenant') or None)
         self._send_json(200, {'count': len(reqs),
                               'capacity': rec.capacity,
                               'requests': reqs})

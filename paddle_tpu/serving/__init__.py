@@ -23,22 +23,34 @@ Fleet serving (``fleet.py``): a ``ReplicaSet`` of N engines behind a
 that loses no request and duplicates no stream token, load shedding with
 a ``retry_after_ms`` hint, SLO-driven autoscaling from a warm template,
 and graceful drain for zero-drop rolling restarts.
+
+Multi-tenant hosting (``host.py``): a ``ModelHost`` owning N
+heterogeneous engines behind one HBM watermark — admission measured via
+``perf.hbm_bytes``, LRU eviction of cold models that keeps warmup
+manifests (swap-in is seconds, zero retraces), interactive/batch
+priority lanes with SLO-driven batch shedding, and per-tenant quotas +
+``request.*`` accounting. The fleet router targets hosted models as
+``submit(..., target='model@host')``.
 """
 from .bucketing import (bucket_for, bucket_sizes, input_signature,  # noqa: F401
                         pad_rows)
 from .bucket_cache import BucketCompileCache  # noqa: F401
 from .errors import (DeadlineExceededError, EngineClosedError,  # noqa: F401
-                     QueueFullError)
+                     HBMAdmissionError, QueueFullError)
 from .metrics import ServingStats  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .generation import GenerationEngine, GenerationFuture  # noqa: F401
 from .fleet import (Autoscaler, FleetRouter, Replica,  # noqa: F401
                     ReplicaSet)
+from .host import (HostedModel, ModelHost, get_host,  # noqa: F401
+                   resolve_target)
 
 __all__ = [
     'InferenceEngine', 'ServingStats', 'BucketCompileCache',
     'GenerationEngine', 'GenerationFuture',
     'ReplicaSet', 'FleetRouter', 'Autoscaler', 'Replica',
+    'ModelHost', 'HostedModel', 'get_host', 'resolve_target',
     'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',
     'QueueFullError', 'DeadlineExceededError', 'EngineClosedError',
+    'HBMAdmissionError',
 ]

@@ -353,6 +353,16 @@ class InferenceEngine:
             rec = _obs.start_request(
                 'serve', engine=self._stats.labels['engine'], rows=n)
         future.request_id = rec.rid
+        if deadline_t is not None and now >= deadline_t:
+            # already unmeetable: fail fast instead of queueing a request
+            # that would only burn a dispatch slot before expiring
+            waited = (now - enqueue_t) * 1e3
+            limit = (deadline_t - enqueue_t) * 1e3
+            err = DeadlineExceededError(waited, limit)
+            self._stats.note_expired()
+            rec.note('expire', waited_ms=round(waited, 3), fast_fail=True)
+            rec.finish('expired', err)
+            raise err
         max_b = self.max_batch_size
         if n <= max_b:
             chunks = [(arrays, future)]

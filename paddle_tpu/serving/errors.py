@@ -43,3 +43,20 @@ class DeadlineExceededError(RetryError):
 
 class EngineClosedError(RuntimeError):
     """submit() after shutdown(): the dispatch thread is gone."""
+
+
+class HBMAdmissionError(RuntimeError):
+    """A ModelHost refused to admit a model: its HBM footprint plus live
+    usage does not fit under the host watermark, and no cold model was
+    left to evict. Typed so deployment tooling can distinguish "host is
+    genuinely full" from transient serve-path failures."""
+
+    def __init__(self, model, needed_bytes, free_bytes, watermark_bytes):
+        super().__init__(
+            f'model {model!r} needs {needed_bytes} HBM bytes but only '
+            f'{free_bytes} fit under the {watermark_bytes}-byte watermark '
+            f'(no evictable cold models remain)')
+        self.model = model
+        self.needed_bytes = int(needed_bytes)
+        self.free_bytes = int(free_bytes)
+        self.watermark_bytes = int(watermark_bytes)

@@ -586,14 +586,27 @@ class FleetRouter:
         self._post(('done', record.attempt))
 
     # ---- front door ------------------------------------------------------
-    def submit(self, *args, deadline_ms=None, max_new_tokens=32, seed=0):
+    def submit(self, *args, deadline_ms=None, max_new_tokens=32, seed=0,
+               target=None, tenant='default', lane='interactive'):
         """Route one request. Generation fleets take ``submit(prompt,
         max_new_tokens=, seed=, deadline_ms=)`` and return a
         :class:`GenerationFuture`; inference fleets take
         ``submit(*inputs, deadline_ms=)`` and return a Future.
 
+        ``target='model@host'`` bypasses replica scoring entirely and
+        forwards to that :class:`~.host.ModelHost`'s hosted model (with
+        ``tenant``/``lane`` riding along) — the multi-model hosting
+        front door behind the same fleet API.
+
         Raises :class:`QueueFullError` (with ``retry_after_ms``) only
         when every replica is saturated."""
+        if target is not None:
+            from .host import resolve_target
+            host, model = resolve_target(target)
+            _obs.counter('fleet.host_routed', self._labels).inc()
+            return host.submit(model, *args, tenant=tenant, lane=lane,
+                               deadline_ms=deadline_ms,
+                               max_new_tokens=max_new_tokens, seed=seed)
         kind = self.set.kind
         if kind is None or self._closed:
             raise EngineClosedError('fleet router is closed or empty')
@@ -689,8 +702,9 @@ class FleetRouter:
                 inner = rep.engine.submit(
                     *freq.payload, _record=rec,
                     _enqueue_t=freq.enqueue_t, _deadline_t=freq.deadline_t)
-        except (QueueFullError, EngineClosedError):
-            # the engine finished the attempt record ('rejected'); that
+        except (QueueFullError, EngineClosedError, DeadlineExceededError):
+            # the engine finished the attempt record ('rejected', or
+            # 'expired' from the submit-time deadline fast-fail); that
             # event — the single failure path — drives the reroute
             return 'ok'
         except Exception:

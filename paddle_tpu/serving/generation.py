@@ -551,6 +551,16 @@ class GenerationEngine:
             rec = _obs.start_request('gen', engine=self.labels['engine'],
                                      prompt_len=t0, max_new=eff)
         fut.request_id = rec.rid
+        if deadline_t is not None and now >= deadline_t:
+            # already unmeetable: fail fast instead of queueing a request
+            # the admitter would only expire after it reached a slot
+            waited = (now - enqueue_t) * 1e3
+            limit = (deadline_t - enqueue_t) * 1e3
+            err = DeadlineExceededError(waited, limit)
+            self._note('expired')
+            rec.note('expire', waited_ms=round(waited, 3), fast_fail=True)
+            rec.finish('expired', err)
+            raise err
         req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, enqueue_t,
                        deadline_t, rec=rec)
         try:

@@ -323,3 +323,49 @@ def test_hedge_rescues_request_stuck_on_stalled_replica():
         assert c is not None and c.value >= 1
     finally:
         router.close(drain=False)
+
+
+def test_hedge_winner_with_breaker_opening_midflight_delivers_once():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    stalled = InferenceEngine(
+        net, autostart=False,
+        breaker=fault.CircuitBreaker(failure_threshold=1,
+                                     recovery_timeout=300.0))
+    healthy = InferenceEngine(net, max_batch_size=8, max_delay_ms=0.5)
+    rs = ReplicaSet(replicas=[stalled, healthy])
+    router = FleetRouter(rs, hedge_ms=40, tick_s=0.01)
+    try:
+        x = np.random.rand(3, 8).astype('float32')
+        want = np.asarray(net(paddle.to_tensor(x)))
+        fut = router.submit(x)
+        got = np.asarray(fut.result(timeout=60))      # hedge twin wins
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        c = obs.find('fleet.hedge', {'fleet': rs.name})
+        assert c is not None and c.value >= 1
+        # now the primary's replica breaker opens while its attempt is
+        # still queued, and THEN the stalled engine wakes up: the
+        # abandoned attempt fails on its open breaker (CircuitOpenError)
+        # and must be recognized as stale — the master future keeps the
+        # hedge winner's result (no second set_result, no
+        # InvalidStateError) and no in-flight request leaks
+        stalled._breaker.record_failure()
+        assert stalled.stats()['circuit_state'] == 'open'
+        stalled.start()
+        deadline = time.time() + 30
+        while True:
+            with router._lock:
+                if not router._inflight:
+                    break               # primary attempt fully resolved
+            assert time.time() < deadline, 'primary attempt never drained'
+            time.sleep(0.01)
+        np.testing.assert_allclose(np.asarray(fut.result(timeout=1)),
+                                   want, rtol=1e-5)
+        # with the primary's breaker open, new traffic routes around it
+        got2 = np.asarray(router.submit(x).result(timeout=60))
+        np.testing.assert_allclose(got2, want, rtol=1e-5)
+        assert stalled.stats()['completed'] == 0
+        errors = obs.find('fleet.control_errors', {'fleet': rs.name})
+        assert errors is None or errors.value == 0
+    finally:
+        router.close(drain=False)

@@ -16,8 +16,8 @@ from paddle_tpu import observability as obs
 from paddle_tpu.models import DecodeFnCache, clear_decode_caches
 from paddle_tpu.models import gpt, moe_gpt
 from paddle_tpu.ops import paged_kv
-from paddle_tpu.serving import (DeadlineExceededError, GenerationEngine,
-                                QueueFullError)
+from paddle_tpu.serving import (DeadlineExceededError, EngineClosedError,
+                                GenerationEngine, QueueFullError)
 
 # ops/__init__ rebinds `flash_attention` to the FUNCTION, shadowing the
 # submodule for attribute-style imports — importlib reaches the module
@@ -380,7 +380,15 @@ def test_queue_full_and_deadline(params):
         eng.submit(p, max_new_tokens=2)
     eng.shutdown(drain=False)
     eng2 = _engine(params, autostart=False)
-    fut = eng2.submit(p, max_new_tokens=2, deadline_ms=0)
+    # an already-expired deadline fast-fails at submit instead of queueing
+    # a request the scheduler could only expire once it reached a slot
+    with pytest.raises(DeadlineExceededError):
+        eng2.submit(p, max_new_tokens=2, deadline_ms=0)
+    assert eng2.stats()['expired'] == 1
+    # a deadline that lapses WHILE queued still expires through the drain
+    import time as _time
+    fut = eng2.submit(p, max_new_tokens=2, deadline_ms=20)
+    _time.sleep(0.05)
     eng2.shutdown()                     # inline drain: expires the request
     assert isinstance(fut.exception(timeout=10), DeadlineExceededError)
 
@@ -415,17 +423,27 @@ def test_resubmission_hooks_preserve_record_and_deadline(params):
     p = _prompts([4])[0]
     now = _time.monotonic()
     rec = obs.start_request('gen', engine=eng.labels['engine'])
-    # a failed-over request arrives with its ORIGINAL submit timestamp and
-    # absolute deadline — both already in the past here
-    fut = eng.submit(p, max_new_tokens=2, _record=rec,
-                     _enqueue_t=now - 5.0, _deadline_t=now - 1.0)
-    assert fut.request_id == rec.rid       # no new record minted
-    eng.shutdown()                          # inline drain: expires it
-    err = fut.exception(timeout=10)
-    assert isinstance(err, DeadlineExceededError)
-    # waited/limit are measured from the original enqueue, not this submit
-    assert err.waited_ms >= 4900.0
-    assert 3900.0 <= err.deadline_ms <= 4100.0
+    # a failed-over request arriving with its ORIGINAL absolute deadline
+    # already in the past fast-fails at submit — but the accounting is
+    # still measured from the original enqueue, not this resubmission
+    with pytest.raises(DeadlineExceededError) as ei:
+        eng.submit(p, max_new_tokens=2, _record=rec,
+                   _enqueue_t=now - 5.0, _deadline_t=now - 1.0)
+    assert ei.value.waited_ms >= 4900.0
+    assert 3900.0 <= ei.value.deadline_ms <= 4100.0
+    looked = obs.recorder().lookup(rec.rid)
+    assert looked['outcome'] == 'expired'  # the SAME record was sealed
+    assert any(e['ev'] == 'expire' and e.get('fast_fail')
+               for e in looked['timeline'])
+    # a resubmission whose deadline is still ahead rides the hooks into
+    # the queue under the original record — no new record minted
+    rec2 = obs.start_request('gen', engine=eng.labels['engine'])
+    fut = eng.submit(p, max_new_tokens=2, _record=rec2,
+                     _enqueue_t=now - 5.0, _deadline_t=now + 30.0)
+    assert fut.request_id == rec2.rid
+    eng.shutdown(drain=False)
+    assert isinstance(fut.exception(timeout=10), EngineClosedError)
+    assert obs.recorder().lookup(rec2.rid)['outcome'] == 'cancelled'
 
 
 def test_prompt_validation(params):

@@ -170,25 +170,29 @@ def test_deadline_expiry_is_retryerror_family_not_a_hang():
     eng = InferenceEngine(_net(), max_batch_size=8, max_delay_ms=1.0,
                           autostart=False)
     x = np.random.rand(2, 8).astype('float32')
-    fut = eng.submit(x, deadline_ms=0.0)        # expired on arrival
-    time.sleep(0.01)
-    eng.start()
+    # expired on arrival: fast-fails at submit instead of burning a
+    # dispatch slot on a request that can only expire
     with pytest.raises(DeadlineExceededError) as ei:
-        fut.result(timeout=30)                  # resolves promptly, no hang
+        eng.submit(x, deadline_ms=0.0)
     assert isinstance(ei.value, RetryError)     # RetryError-family contract
     assert eng.stats()['expired'] == 1
+    # a deadline that lapses WHILE queued resolves promptly, no hang
+    fut = eng.submit(x, deadline_ms=5.0)
+    time.sleep(0.02)
+    eng.start()
+    with pytest.raises(DeadlineExceededError) as ei2:
+        fut.result(timeout=30)
+    assert isinstance(ei2.value, RetryError)
+    assert eng.stats()['expired'] == 2
     eng.shutdown()
 
 
 def test_default_deadline_applies_to_every_request():
     eng = InferenceEngine(_net(), max_batch_size=8, max_delay_ms=1.0,
                           default_deadline_ms=0.0, autostart=False)
-    fut = eng.submit(np.random.rand(1, 8).astype('float32'))
-    time.sleep(0.01)
-    eng.start()
     with pytest.raises(DeadlineExceededError):
-        fut.result(timeout=30)
-    eng.shutdown()
+        eng.submit(np.random.rand(1, 8).astype('float32'))
+    eng.shutdown(drain=False)
 
 
 def test_submit_after_shutdown_and_no_drain_failfast():

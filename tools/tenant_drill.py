@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Multi-tenant overload drill: the ISSUE-13 acceptance gate, runnable
+anywhere (CPU-safe, fresh subprocess).
+
+One child process builds a three-model :class:`ModelHost` (two GPT
+generation models plus an MLP inference model — a heterogeneous mix on
+one HBM budget) and drives four phases:
+
+  1. **baseline** — N interactive streams against the unloaded host;
+     per-request end-to-end latencies give ``baseline_p99_ms``;
+  2. **2x overload** — the same interactive wave while a batch-lane
+     flood (2x the interactive count, separate tenant) hammers the same
+     models: interactive latencies give ``overload_p99_ms`` and the
+     blast-radius ratio, while every shed batch request must carry a
+     measured ``retry_after_ms`` backoff hint
+     (``shed_count`` / ``sheds_with_hint``);
+  3. **admission** — a deploy whose declared footprint cannot fit even
+     after evicting every cold model must be refused with
+     ``HBMAdmissionError`` and ZERO evictions, and the host's HBM
+     accounting must never exceed the watermark (``watermark_ok``);
+  4. **evict + swap-in mid-traffic** — continuous interactive traffic
+     runs against the hot model while a new deploy LRU-evicts the cold
+     one and a follow-up request transparently swaps it back in from
+     its warmth snapshot: zero interactive requests may be lost
+     (``lost_interactive``) and the swapped-in engine must compile
+     ZERO new executables (``swap_in_traces``).
+
+Prints ONE json line::
+
+  {"baseline_p99_ms": 210.0, "overload_p99_ms": 330.0, "p99_ratio": 1.6,
+   "shed_count": 11, "sheds_with_hint": 11, "admission_rejects": 1,
+   "watermark_ok": true, "evictions": 1, "swap_in_ms": 8.4,
+   "swap_in_traces": 0, "lost_interactive": 0, "ok": true}
+
+``ok`` requires: p99_ratio <= 3, at least one shed with every shed
+hinted, the infeasible deploy refused, the watermark never exceeded,
+at least one eviction, a zero-retrace swap-in, and zero lost
+interactive requests. Exit code 0 iff ok. ``run_drill()`` is
+importable from bench.py.
+
+Usage: python tools/tenant_drill.py [--requests N] [--tokens T]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+P99_RATIO_LIMIT = 3.0
+MB = 1 << 20
+
+
+def _p99(samples):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * 0.99))] if s else 0.0
+
+
+def _child(n_interactive, n_tokens):
+    import numpy as np
+    import jax
+    from paddle_tpu import nn
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (GenerationEngine, HBMAdmissionError,
+                                    InferenceEngine, ModelHost,
+                                    QueueFullError)
+
+    cfg = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(1, cfg.vocab_size, size=4 + i % 5)
+               for i in range(n_interactive)]
+
+    def gen_factory():
+        return GenerationEngine(params, cfg, num_slots=2, page_size=8,
+                                prefill_width=16, queue_capacity=16)
+
+    def vision_factory():
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        return InferenceEngine(net, max_batch_size=8, max_delay_ms=0.5,
+                               queue_capacity=16)
+
+    # Declared footprints make admission arithmetic deterministic on any
+    # platform (the measured footprints of these toy models are far
+    # smaller): 4 + 4 + 2 = 10 MB live under an 11 MB watermark, so the
+    # fourth 4 MB model fits ONLY by evicting a cold one and the 40 MB
+    # model fits never.
+    host = ModelHost(hbm_watermark_bytes=11 * MB, name='drill',
+                     interactive_p99_ms=50.0, slo_interval=0.05,
+                     slo_debounce=2, batch_share=0.25)
+    host.deploy('chat', gen_factory, footprint_bytes=4 * MB)
+    host.deploy('draft', gen_factory, footprint_bytes=4 * MB)
+    host.deploy('vision', vision_factory, footprint_bytes=2 * MB,
+                input_spec=[((8,), 'float32')])
+
+    out = {}
+    watermark_ok = [host.stats()['hbm_used_bytes']
+                    <= host.watermark_bytes]
+
+    def interactive_wave():
+        """Submit every prompt on the interactive lane plus one vision
+        request, stream/await each to completion; returns (per-request
+        end-to-end ms, lost count)."""
+        t0, futs = {}, []
+        for i, p in enumerate(prompts):
+            t0[i] = time.perf_counter()
+            futs.append(host.submit('chat', p, tenant='acme',
+                                    lane='interactive',
+                                    max_new_tokens=n_tokens, seed=i))
+        vfut = host.submit('vision', np.zeros((8,), np.float32),
+                           tenant='acme', lane='interactive')
+        lats, lost = [], 0
+        for i, f in enumerate(futs):
+            try:
+                list(f.stream(timeout=300))
+            except Exception:
+                lost += 1
+            lats.append((time.perf_counter() - t0[i]) * 1e3)
+        try:
+            vfut.result(timeout=300)
+        except Exception:
+            lost += 1
+        return lats, lost
+
+    # warm pass: first-touch costs (bucket compiles, cache population)
+    # must not be charged to the baseline the overload ratio divides by
+    _, warm_lost = interactive_wave()
+
+    # phase 1: unloaded baseline
+    base_lats, base_lost = interactive_wave()
+    out['baseline_p99_ms'] = round(_p99(base_lats), 3)
+
+    # phase 2: the same wave under a 2x batch-lane flood from a second
+    # tenant; the 25% batch_share cap plus the queue-wait SLO shed the
+    # overflow, and every shed must carry a retry_after_ms hint
+    shed = {'count': 0, 'hinted': 0}
+    stop_flood = threading.Event()
+
+    def flood():
+        k = 0
+        while not stop_flood.is_set():
+            mdl = ('chat', 'vision')[k % 2]
+            try:
+                if mdl == 'chat':
+                    host.submit('chat', prompts[k % len(prompts)],
+                                tenant='bulk', lane='batch',
+                                max_new_tokens=n_tokens, seed=100 + k)
+                else:
+                    host.submit('vision', np.zeros((8,), np.float32),
+                                tenant='bulk', lane='batch')
+            except QueueFullError as e:
+                shed['count'] += 1
+                if e.retry_after_ms:
+                    shed['hinted'] += 1
+                time.sleep(0.002)
+            k += 1
+            if k >= 2 * n_interactive:
+                time.sleep(0.005)   # sustained 2x offered load, paced
+
+    flooder = threading.Thread(target=flood, daemon=True)
+    flooder.start()
+    time.sleep(0.05)                # let the flood saturate the batch cap
+    over_lats, over_lost = interactive_wave()
+    stop_flood.set()
+    flooder.join(timeout=30)
+    out['overload_p99_ms'] = round(_p99(over_lats), 3)
+    out['p99_ratio'] = round(
+        out['overload_p99_ms'] / max(out['baseline_p99_ms'], 1e-9), 3)
+    out['shed_count'] = shed['count']
+    out['sheds_with_hint'] = shed['hinted']
+    watermark_ok.append(host.stats()['hbm_used_bytes']
+                        <= host.watermark_bytes)
+
+    # phase 3: infeasible admission must be refused without stripping
+    # the host (needs 40 MB; even evicting every cold model frees < that)
+    rejects = 0
+    try:
+        host.deploy('huge', gen_factory, footprint_bytes=40 * MB)
+    except HBMAdmissionError:
+        rejects = 1
+    out['admission_rejects'] = rejects
+    states = {n: d['state'] for n, d in host.models().items()}
+    rejects_clean = all(s == 'live' for s in states.values())
+    watermark_ok.append(host.stats()['hbm_used_bytes']
+                        <= host.watermark_bytes)
+
+    # phase 4: evict + swap-in while interactive traffic keeps flowing.
+    # 'draft' is the LRU cold model (never submitted to); deploying the
+    # 2 MB 'extra' must evict exactly it, and the follow-up submit must
+    # swap it back in from the warmth snapshot (LRU-cascading onto the
+    # cold 'vision' model for the last 2 MB) with zero new traces.
+    pacer_lost = [0]
+    stop_pacer = threading.Event()
+
+    def pacer():
+        i = 0
+        while not stop_pacer.is_set():
+            try:
+                f = host.submit('chat', prompts[i % len(prompts)],
+                                tenant='acme', lane='interactive',
+                                max_new_tokens=4, seed=500 + i)
+                list(f.stream(timeout=300))
+            except Exception:
+                pacer_lost[0] += 1
+            i += 1
+
+    pace = threading.Thread(target=pacer, daemon=True)
+    pace.start()
+    host.deploy('extra', gen_factory, footprint_bytes=2 * MB)
+    swapped = host.submit('draft', prompts[0], tenant='acme',
+                          lane='interactive', max_new_tokens=n_tokens,
+                          seed=0)
+    swap_tokens = list(swapped.stream(timeout=300))
+    time.sleep(0.1)
+    stop_pacer.set()
+    pace.join(timeout=60)
+
+    states = {n: d['state'] for n, d in host.models().items()}
+    st = host.stats()
+    out['evictions'] = st['evictions']
+    out['lost_interactive'] = warm_lost + base_lost + over_lost \
+        + pacer_lost[0] + (0 if swap_tokens else 1)
+    # the swapped-in engine must have rebuilt entirely from the warmth
+    # snapshot: zero jit traces since construction
+    out['swap_in_traces'] = int(
+        host._models['draft'].engine.stats()['traces'])
+    h = obs.find('host.swap_in_ms', {'host': host.name})
+    out['swap_in_ms'] = (round(h.percentile(50), 3)
+                         if h is not None and h.count else -1.0)
+    watermark_ok.append(st['hbm_used_bytes'] <= host.watermark_bytes)
+    out['watermark_ok'] = bool(all(watermark_ok) and rejects_clean
+                               and states['draft'] == 'live'
+                               and states['extra'] == 'live')
+    host.close()
+
+    print(json.dumps(out))
+
+
+def run_drill(n_interactive=6, n_tokens=16, timeout=900):
+    """Run the drill in a fresh subprocess; returns the summary dict with
+    the aggregate ``ok`` verdict (importable from bench.py and tests)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child',
+         '--requests', str(n_interactive), '--tokens', str(n_tokens)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'tenant drill child failed:\n{proc.stdout}\n'
+                           f'{proc.stderr}')
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out['ok'] = bool(out['p99_ratio'] <= P99_RATIO_LIMIT
+                     and out['shed_count'] > 0
+                     and out['sheds_with_hint'] == out['shed_count']
+                     and out['admission_rejects'] >= 1
+                     and out['watermark_ok']
+                     and out['evictions'] >= 1
+                     and out['swap_in_traces'] == 0
+                     and out['lost_interactive'] == 0)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--requests', type=int, default=6,
+                    help='interactive requests per wave')
+    ap.add_argument('--tokens', type=int, default=16)
+    ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.requests, args.tokens)
+        return 0
+    result = run_drill(n_interactive=args.requests, n_tokens=args.tokens)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
