@@ -662,6 +662,22 @@ def _child_tenant():
     print(json.dumps(tenant_drill.run_drill()))
 
 
+def _child_fleet_obs():
+    """Fleet observability gate row: tools/fleet_obs_check.py in a fresh
+    subprocess — federated counters bit-equal to per-replica sums, a
+    kill-mid-stream failover stitched into ONE cross-replica timeline
+    with zero duplicate events, the staleness gauge firing for the dead
+    replica only, a non-empty on-demand profile capture (second
+    concurrent request → 409), and the federation pass inside the <5%
+    observability budget. The parent banks the fleet_obs_* columns."""
+    _arm_watchdog(900)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import fleet_obs_check
+    print(json.dumps(fleet_obs_check.run_check()))
+
+
 def _child_reqtrace_overhead():
     """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
     GenerationEngine with the telemetry plane attached, run by the parent
@@ -1239,6 +1255,23 @@ def main(fast=False):
         else:
             print(f'tenant drill failed: {tdnote}', file=sys.stderr)
 
+        # fleet observability gate: federation math, cross-replica trace
+        # stitching through a kill-mid-stream failover, staleness for the
+        # dead replica, bounded on-demand profiling (fresh process)
+        fo, fonote = _run_child(['--child-fleet-obs'], 900,
+                                env={'BENCH_CHILD_TIMEOUT': '900'})
+        if fo is not None:
+            out['fleet_obs_ok'] = bool(fo.get('ok'))
+            out['fleet_obs_counter_mismatches'] = fo.get(
+                'counter_mismatches')
+            out['fleet_obs_stitched_replicas'] = fo.get('stitched_replicas')
+            out['fleet_obs_dup_events'] = fo.get('dup_events')
+            out['fleet_obs_staleness_dead_s'] = fo.get('staleness_dead_s')
+            out['fleet_obs_profile_bytes'] = fo.get('profile_bytes')
+            out['fleet_obs_fed_overhead_pct'] = fo.get('fed_overhead_pct')
+        else:
+            print(f'fleet obs check failed: {fonote}', file=sys.stderr)
+
         # request-tracing overhead A/B on the decode rung: flight recorder
         # + telemetry server enabled vs hard-disabled; budget is <5%
         rt_res = {}
@@ -1372,6 +1405,8 @@ if __name__ == '__main__':
         _child_fleet()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-tenant':
         _child_tenant()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-fleet-obs':
+        _child_fleet_obs()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

@@ -15,6 +15,8 @@ through it under a shared namespace:
 - ``slo.*``   — SLO watcher breach counters and firing gauges
 - ``request.*`` — request-scoped flight recorder (started/completed/active)
 - ``server.*``  — telemetry HTTP plane request counters
+- ``fleet.obs.*`` — metric federation health (staleness, scrape errors,
+  collect time, profile captures); see ``fleetobs.py``
 
 Quick start::
 
@@ -50,7 +52,11 @@ from .reqtrace import (NULL_RECORD, FlightRecorder,  # noqa: F401
 from .server import (NULL_SERVER, TelemetryServer,  # noqa: F401
                      add_readiness, readiness, remove_readiness,
                      serve_telemetry, servers, shutdown_telemetry)
+from .fleetobs import (FleetObs, MetricFederator,  # noqa: F401
+                       ProfileBusyError, capture_profile, profile_in_flight,
+                       register_gauge_semantics, stitch)
 from . import perf  # noqa: F401  (perf.analyze / note_step / sweep_hbm)
+from . import promparse  # noqa: F401  (shared exposition parser)
 from . import slo   # noqa: F401  (slo.Watcher / slo.watcher())
 
 ENV_OBS = 'PADDLE_TPU_OBS'
@@ -65,7 +71,9 @@ __all__ = [
     'start_request', 'recorder', 'reset_requests',
     'serve_telemetry', 'servers', 'shutdown_telemetry', 'TelemetryServer',
     'add_readiness', 'remove_readiness', 'readiness',
-    'perf', 'slo',
+    'FleetObs', 'MetricFederator', 'ProfileBusyError', 'capture_profile',
+    'profile_in_flight', 'register_gauge_semantics', 'stitch',
+    'perf', 'promparse', 'slo',
 ]
 
 

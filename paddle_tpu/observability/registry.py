@@ -274,33 +274,49 @@ _TYPES = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.RLock()
-        self._families = {}     # name -> (type_name, {label_key: child})
+        self._families = {}     # name -> [type_name, {label_key: child}]
+        self._help = {}         # name -> help text (family-level)
 
-    def _child(self, type_name, name, labels, **kwargs):
+    def _child(self, type_name, name, labels, help=None, **kwargs):
         lk = tuple(sorted((labels or {}).items()))
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
                 fam = (type_name, {})
                 self._families[name] = fam
+                # help is registered at family creation; the default is
+                # the metric name so strict scrapers always see a # HELP
+                self._help[name] = str(help) if help else name
             elif fam[0] != type_name:
                 raise ValueError(
                     f'metric {name!r} already registered as {fam[0]}, '
                     f'requested as {type_name}')
+            elif help:
+                # a later call site that DOES know the semantics upgrades
+                # a default (name-only) help; explicit text never churns
+                if self._help.get(name) in (None, name):
+                    self._help[name] = str(help)
             child = fam[1].get(lk)
             if child is None:
                 child = _TYPES[type_name](name, labels, **kwargs)
                 fam[1][lk] = child
             return child
 
-    def counter(self, name, labels=None):
-        return self._child('counter', name, labels)
+    def counter(self, name, labels=None, help=None):
+        return self._child('counter', name, labels, help=help)
 
-    def gauge(self, name, labels=None):
-        return self._child('gauge', name, labels)
+    def gauge(self, name, labels=None, help=None):
+        return self._child('gauge', name, labels, help=help)
 
-    def histogram(self, name, labels=None, window=DEFAULT_WINDOW):
-        return self._child('histogram', name, labels, window=window)
+    def histogram(self, name, labels=None, window=DEFAULT_WINDOW,
+                  help=None):
+        return self._child('histogram', name, labels, help=help,
+                           window=window)
+
+    def help_text(self, name):
+        """The registered family help (None for unknown families)."""
+        with self._lock:
+            return self._help.get(name)
 
     def find(self, name, labels=None):
         """Read-only lookup: the existing child for (name, labels) or
@@ -317,27 +333,32 @@ class MetricsRegistry:
     def reset(self):
         with self._lock:
             self._families.clear()
+            self._help.clear()
 
     def _items(self):
         with self._lock:
-            return [(name, t, list(children.values()))
+            return [(name, t, list(children.values()),
+                     self._help.get(name, name))
                     for name, (t, children) in sorted(self._families.items())]
 
     def snapshot(self):
         """JSON-serializable view of every registered series."""
         out = {'ts': time.time(),
                'counters': {}, 'gauges': {}, 'histograms': {}}
-        for name, t, children in self._items():
+        for name, t, children, _ in self._items():
             section = out[t + 's']
             for c in children:
                 section[c.key] = c.stats() if t == 'histogram' else c.value
         return out
 
     def to_prometheus(self):
-        """Prometheus text exposition format (histograms as summaries)."""
+        """Prometheus text exposition format (histograms as summaries),
+        with ``# HELP`` alongside every ``# TYPE`` so the exposition
+        survives strict scrapers when federated."""
         lines = []
-        for name, t, children in self._items():
+        for name, t, children, help_text in self._items():
             pname = _prom_name(name)
+            lines.append(f'# HELP {pname} {_prom_help(help_text)}')
             lines.append(f'# TYPE {pname} '
                          f'{"summary" if t == "histogram" else t}')
             for c in children:
@@ -363,6 +384,11 @@ def _prom_name(name):
                    for ch in name)
 
 
+def _prom_help(text):
+    # exposition-format HELP escaping: backslash and newline only
+    return str(text).replace('\\', '\\\\').replace('\n', '\\n')
+
+
 def _prom_labels(labels):
     if not labels:
         return ''
@@ -382,22 +408,22 @@ def registry():
     return _default
 
 
-def counter(name, labels=None):
+def counter(name, labels=None, help=None):
     if not cfg.enabled:
         return NULL_METRIC
-    return _default.counter(name, labels)
+    return _default.counter(name, labels, help=help)
 
 
-def gauge(name, labels=None):
+def gauge(name, labels=None, help=None):
     if not cfg.enabled:
         return NULL_METRIC
-    return _default.gauge(name, labels)
+    return _default.gauge(name, labels, help=help)
 
 
-def histogram(name, labels=None, window=DEFAULT_WINDOW):
+def histogram(name, labels=None, window=DEFAULT_WINDOW, help=None):
     if not cfg.enabled:
         return NULL_METRIC
-    return _default.histogram(name, labels, window=window)
+    return _default.histogram(name, labels, window=window, help=help)
 
 
 def find(name, labels=None):

@@ -31,6 +31,7 @@ Env knobs: ``PADDLE_TPU_OBS_REQ_CAP`` (ring capacity, default 256),
 ``PADDLE_TPU_OBS_SLOW_MS`` (slow-request retention threshold, default
 1000 ms).
 """
+import collections
 import itertools
 import os
 import threading
@@ -195,6 +196,11 @@ class FlightRecorder:
         self._ids = itertools.count(1)
         self._active = {}            # rid -> RequestRecord
         self._done = []              # completion order, oldest first
+        # records evicted from the ring stay findable BY ID for one more
+        # generation: the cross-replica stitcher must be able to recover
+        # every part of a split or failed-over request even after fresh
+        # traffic has cycled the main ring (bounded — never a leak)
+        self._evicted = collections.deque(maxlen=self.capacity)
 
     # ---- lifecycle -------------------------------------------------------
     def start(self, kind, engine='', **attrs):
@@ -230,7 +236,7 @@ class FlightRecorder:
             while len(self._done) > self.capacity:
                 victim = next((i for i, r in enumerate(self._done)
                                if not self._notable(r)), 0)
-                self._done.pop(victim)
+                self._evicted.append(self._done.pop(victim))
             n_active = len(self._active)
         lbl = {'kind': rec.kind, 'outcome': rec.outcome or '?'}
         if 'tenant' in rec.attrs:
@@ -240,22 +246,35 @@ class FlightRecorder:
 
     # ---- queries ---------------------------------------------------------
     def lookup(self, rid):
-        """The record dict for ``rid`` (in flight or completed), or None."""
+        """The record dict for ``rid`` (in flight, completed, or evicted
+        from the ring but still in the archive), or None."""
         with self._lock:
             rec = self._active.get(rid)
             if rec is None:
                 rec = next((r for r in self._done if r.rid == rid), None)
+            if rec is None:
+                rec = next((r for r in self._evicted if r.rid == rid), None)
         return rec.to_dict() if rec is not None else None
 
     def requests(self, outcome=None, rid=None, limit=None, tenant=None):
         """Newest-first list of record dicts. ``outcome`` filters completed
         records ('ok', 'error', 'expired', 'rejected', or 'active' for the
-        in-flight set); ``rid`` selects one request; ``tenant`` filters on
-        the ``tenant`` attr a ModelHost stamps onto every request it
-        routes (per-tenant blast-radius triage)."""
+        in-flight set); ``rid`` returns EVERY record carrying that ID —
+        searching the in-flight set, the completed ring, AND the evicted
+        archive — so the cross-replica stitcher (``fleetobs.stitch``) and
+        ``/debug/requests?id=`` find all parts of a split or failed-over
+        request; ``tenant`` filters on the ``tenant`` attr a ModelHost
+        stamps onto every request it routes (per-tenant blast-radius
+        triage)."""
         if rid:
-            found = self.lookup(rid)
-            return [found] if found is not None else []
+            with self._lock:
+                found = []
+                rec = self._active.get(rid)
+                if rec is not None:
+                    found.append(rec)
+                found.extend(r for r in self._done if r.rid == rid)
+                found.extend(r for r in self._evicted if r.rid == rid)
+            return [r.to_dict() for r in found]
         with self._lock:
             done = list(reversed(self._done))
             active = list(self._active.values())
@@ -274,10 +293,12 @@ class FlightRecorder:
     def set_capacity(self, n):
         with self._lock:
             self.capacity = max(1, int(n))
+            self._evicted = collections.deque(self._evicted,
+                                              maxlen=self.capacity)
             while len(self._done) > self.capacity:
                 victim = next((i for i, r in enumerate(self._done)
                                if not self._notable(r)), 0)
-                self._done.pop(victim)
+                self._evicted.append(self._done.pop(victim))
         return self.capacity
 
     def __len__(self):
@@ -288,6 +309,7 @@ class FlightRecorder:
         with self._lock:
             self._active.clear()
             self._done.clear()
+            self._evicted.clear()
 
 
 class _NullRecorder:

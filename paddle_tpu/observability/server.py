@@ -25,6 +25,19 @@ Endpoints:
   events recorded in that window as a chrome://tracing-loadable document;
   ``?cap=N`` bounds the ring for the capture via ``set_trace_cap``.
 - ``GET /debug/slo`` — every SLO watcher rule's ok/firing state.
+- ``GET /debug/fleet`` — replica + host tables when a
+  :class:`~.fleetobs.FleetObs` plane is attached (404 otherwise).
+- ``GET /debug/profile?ms=N`` — bounded on-demand ``jax.profiler``
+  device capture (``fleetobs.capture_profile``): one capture at a time
+  (a concurrent request gets **409**), window clamped to
+  ``fleetobs.MAX_PROFILE_WINDOW_MS``, summary JSON (artifact dir, file
+  list, byte count) returned; 503 when observability is disabled.
+
+A server with a ``FleetObs`` attached (``serve_telemetry(fleetobs=...)``
+or ``FleetObs.serve()``) federates: ``/metrics`` returns the AGGREGATED
+fleet exposition (per-replica series + semantic aggregates + staleness)
+instead of the process registry, and ``/debug/requests?id=`` adds a
+``stitched`` cross-replica timeline next to the raw records.
 
 Start one with ``observability.serve_telemetry(port=0)`` (port 0 picks a
 free port; read it back from ``server.port``), or let an engine own one:
@@ -41,6 +54,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import fleetobs as _fleetobs
 from . import reqtrace as _reqtrace
 from . import slo as _slo
 from . import trace as _trace
@@ -132,7 +146,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- endpoints -------------------------------------------------------
     def _metrics(self, q):
-        self._send(200, to_prometheus(), PROM_CONTENT_TYPE)
+        fobs = self.server._telemetry.fleetobs
+        body = (fobs.to_prometheus() if fobs is not None
+                else to_prometheus())
+        self._send(200, body, PROM_CONTENT_TYPE)
 
     def _healthz(self, q):
         srv = self.server._telemetry
@@ -147,13 +164,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _debug_requests(self, q):
         rec = _reqtrace.recorder()
         limit = q.get('limit')
+        rid = q.get('id') or None
         reqs = rec.requests(outcome=q.get('outcome') or None,
-                            rid=q.get('id') or None,
+                            rid=rid,
                             limit=int(limit) if limit else None,
                             tenant=q.get('tenant') or None)
-        self._send_json(200, {'count': len(reqs),
-                              'capacity': rec.capacity,
-                              'requests': reqs})
+        out = {'count': len(reqs), 'capacity': rec.capacity,
+               'requests': reqs}
+        fobs = self.server._telemetry.fleetobs
+        if rid and fobs is not None:
+            # the fleet view: every part of a failed-over/hedged/split
+            # request (local + peers) merged into one timeline
+            out['stitched'] = fobs.stitch(rid)
+        self._send_json(200, out)
 
     def _debug_trace(self, q):
         ms = min(max(float(q.get('ms', 250.0)), 0.0), MAX_TRACE_WINDOW_MS)
@@ -186,6 +209,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, {'count': len(rules), 'firing': firing,
                               'rules': rules})
 
+    def _debug_fleet(self, q):
+        fobs = self.server._telemetry.fleetobs
+        if fobs is None:
+            self._send_json(404, {'error': 'no fleet observability plane '
+                                           'attached to this server'})
+            return
+        self._send_json(200, fobs.fleet_table())
+
+    def _debug_profile(self, q):
+        ms = float(q.get('ms', 500.0))
+        try:
+            summary = _fleetobs.capture_profile(ms)
+        except _fleetobs.ProfileBusyError as e:
+            self._send_json(409, {'error': str(e), 'busy': True})
+            return
+        if summary.get('disabled'):
+            self._send_json(503, {'error': 'observability disabled '
+                                           '(PADDLE_TPU_OBS=0)'})
+            return
+        self._send_json(200, summary)
+
 
 _ROUTES = {
     '/metrics': _Handler._metrics,
@@ -194,6 +238,8 @@ _ROUTES = {
     '/debug/requests': _Handler._debug_requests,
     '/debug/trace': _Handler._debug_trace,
     '/debug/slo': _Handler._debug_slo,
+    '/debug/fleet': _Handler._debug_fleet,
+    '/debug/profile': _Handler._debug_profile,
 }
 
 
@@ -202,8 +248,9 @@ class TelemetryServer:
     port (read back from ``.port``); the default host is localhost — the
     telemetry plane is an operator surface, not a public one."""
 
-    def __init__(self, port=0, host='127.0.0.1'):
+    def __init__(self, port=0, host='127.0.0.1', fleetobs=None):
         self.host = host
+        self.fleetobs = fleetobs        # FleetObs plane (or None)
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd._telemetry = self
@@ -251,6 +298,7 @@ class _NullServer:
     port = 0
     url = ''
     started = 0.0
+    fleetobs = None
 
     def start(self):
         return self
@@ -271,12 +319,15 @@ _servers_lock = threading.Lock()
 _servers = []
 
 
-def serve_telemetry(port=0, host='127.0.0.1'):
-    """Start a telemetry server (daemon thread) and return it. Returns
-    ``NULL_SERVER`` when observability is disabled — fully inert."""
+def serve_telemetry(port=0, host='127.0.0.1', fleetobs=None):
+    """Start a telemetry server (daemon thread) and return it. Attaching a
+    ``FleetObs`` plane (``fleetobs=``) turns this server into the fleet
+    face: federated ``/metrics``, ``/debug/fleet``, stitched
+    ``/debug/requests?id=``. Returns ``NULL_SERVER`` when observability is
+    disabled — fully inert."""
     if not cfg.enabled:
         return NULL_SERVER
-    srv = TelemetryServer(port=port, host=host).start()
+    srv = TelemetryServer(port=port, host=host, fleetobs=fleetobs).start()
     with _servers_lock:
         _servers.append(srv)
     return srv

@@ -780,6 +780,47 @@ class ModelHost:
                               for t, n in self._tenant_inflight.items()}
         return out
 
+    def debug_table(self):
+        """One ``/debug/fleet`` host row: HBM headroom, per-model
+        residency (live/evicted, footprint, warmth retained), lane-shed
+        and lifecycle counters, and per-tenant inflight vs quota — the
+        operator's one-look answer to "why is this host shedding"."""
+        with self._lock:
+            models = {}
+            for name, m in self._models.items():
+                models[name] = {
+                    'state': m.state, 'kind': m.kind,
+                    'footprint_bytes': m.footprint_bytes,
+                    'inflight': m.inflight,
+                    'batch_inflight': m.batch_inflight,
+                    'shed_batch': m.shed_batch,
+                    'breaker': m.breaker.state,
+                    'pinned': m.pinned,
+                    'swap_ins': m.swap_ins,
+                    'evictions': m.evictions,
+                    'warm_retained': bool(m.warmth or m.manifest)}
+            resident = sorted(n for n, m in self._models.items()
+                              if m.state == _LIVE)
+            evicted = sorted(n for n, m in self._models.items()
+                             if m.state == _EVICTED)
+            return {'host': self.name,
+                    'hbm_watermark_bytes': self.watermark_bytes,
+                    'hbm_used_bytes': self._used_bytes,
+                    'hbm_free_bytes': self.watermark_bytes
+                    - self._used_bytes,
+                    'resident': resident, 'evicted': evicted,
+                    'models': models,
+                    'lane_sheds': self._n['shed'],
+                    'admitted': self._n['admitted'],
+                    'rejected': self._n['rejected'],
+                    'evictions': self._n['evictions'],
+                    'swap_ins': self._n['swap_ins'],
+                    'tenants': {t: {'inflight': n,
+                                    'quota': self._quotas.get(t)}
+                                for t, n in
+                                sorted(self._tenant_inflight.items())},
+                    'closed': self._closed}
+
     # ---- lifecycle -------------------------------------------------------
     def undeploy(self, name, drain=True):
         """Remove a model entirely (manifest and warmth are discarded)."""

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Fleet observability check: the ISSUE-14 acceptance gate, runnable
+anywhere (CPU-safe, fresh subprocess).
+
+One child process builds a two-replica generation fleet behind a
+``FleetRouter``, attaches a :class:`FleetObs` plane
+(``fleetobs.serve(port=0)``), and verifies the whole pane of glass:
+
+  1. **federation math** — after a healthy wave, the aggregated
+     ``/metrics`` is scraped and EVERY counter family's fleet row must
+     equal the sum of its per-replica rows bit-for-bit
+     (``counter_mismatches``);
+  2. **kill mid-stream + stitching** — the ``fleet.failover`` chaos
+     point kills one replica while streams are mid-decode; the failed-
+     over request's ``/debug/requests?id=`` answer must contain ONE
+     stitched timeline whose attempts land on BOTH replicas with a
+     ``failover`` hop, zero duplicate events (``dup_events``), and zero
+     lost requests vs a single-engine reference;
+  3. **staleness** — after the kill, the federated exposition's
+     ``fleet_obs_staleness_s`` for the dead replica must be > 0 while
+     the survivor reads 0;
+  4. **profiling** — ``/debug/profile?ms=N`` must return a non-empty
+     capture (works on CPU) whose summary carries the capture window
+     and artifact path, and a second concurrent request must get 409;
+  5. **overhead** — the federation pass duty cycle (mean collect wall
+     time against a 1 s scrape interval) must stay under the same <5%
+     budget the observability layer has carried since PR 6.
+
+Prints ONE json line::
+
+  {"lost_requests": 0, "stitched_parts": 1, "stitched_replicas": 2,
+   "failover_hops": 1, "dup_events": 0, "counter_families": 12,
+   "counter_mismatches": 0, "staleness_dead_s": 0.41,
+   "profile_bytes": 965, "profile_busy_409": true,
+   "fed_collect_ms": 1.8, "fed_overhead_pct": 0.18, "ok": true}
+
+Exit code 0 iff ok. ``run_check()`` is importable from bench.py.
+
+Usage: python tools/fleet_obs_check.py [--requests N] [--tokens T]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCRAPE_INTERVAL_S = 1.0       # the duty-cycle denominator
+OVERHEAD_BUDGET_PCT = 5.0
+
+
+def _get(url, timeout=60):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode('utf-8')
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode('utf-8')
+
+
+def _child(n_requests, n_tokens):
+    import numpy as np
+    import jax
+    from paddle_tpu import fault
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import gpt
+    from paddle_tpu.observability import fleetobs, promparse
+    from paddle_tpu.serving import (FleetRouter, GenerationEngine,
+                                    ReplicaSet)
+
+    cfg = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(1, cfg.vocab_size, size=4 + i % 5)
+               for i in range(n_requests)]
+
+    def engine(**kw):
+        kw.setdefault('num_slots', 2)
+        kw.setdefault('page_size', 8)
+        kw.setdefault('prefill_width', 16)
+        kw.setdefault('queue_capacity', 64)
+        return GenerationEngine(params, cfg, **kw)
+
+    ref_eng = engine()
+    want = [ref_eng.submit(p, max_new_tokens=n_tokens, seed=i)
+            .result(timeout=300) for i, p in enumerate(prompts)]
+    ref_eng.shutdown()
+
+    engines = [engine(), engine()]
+    for e in engines:
+        e.submit(np.array([3, 1, 4]), max_new_tokens=2,
+                 seed=999).result(timeout=300)
+    rset = ReplicaSet(replicas=engines)
+    router = FleetRouter(rset, tick_s=0.005)
+    fobs = fleetobs.FleetObs(name=rset.name).watch_router(router)
+    srv = fobs.serve(port=0)
+    out = {}
+
+    # ---- phase 1: healthy wave, then verify the federation math ---------
+    futs = [router.submit(p, max_new_tokens=n_tokens, seed=i)
+            for i, p in enumerate(prompts)]
+    healthy = [list(f.stream(timeout=300)) for f in futs]
+    lost = sum(1 for got, ref in zip(healthy, want) if got != ref)
+
+    def _counter_check():
+        """Scrape the AGGREGATED exposition; for every counter family,
+        the fleet row must be the exact integer sum of its per-replica
+        rows. Returns (families_checked, mismatches)."""
+        code, text = _get(srv.url + '/metrics')
+        assert code == 200, text[:300]
+        snap = promparse.parse_text(text)
+        agg, by_rep = {}, {}
+        for key, val in snap['counters'].items():
+            labels = dict(snap['labels'][key])
+            rep = labels.pop('replica', None)
+            base = promparse.fmt_key(key.split('{', 1)[0], labels)
+            if rep is None:
+                agg[base] = val
+            else:
+                by_rep.setdefault(base, []).append(val)
+        checked = mismatches = 0
+        for base, vals in by_rep.items():
+            if base not in agg:
+                continue
+            checked += 1
+            if agg[base] != sum(vals):
+                mismatches += 1
+        return checked, mismatches
+
+    out['counter_families'], out['counter_mismatches'] = _counter_check()
+
+    # ---- phase 2: kill one replica mid-stream, stitch the failover ------
+    futs = [router.submit(p, max_new_tokens=n_tokens, seed=i)
+            for i, p in enumerate(prompts)]
+    time.sleep(0.05)
+    fault.configure('fleet.failover:1.0', seed=7, max_faults=1)
+    try:
+        failover = []
+        for f in futs:
+            try:
+                failover.append(list(f.stream(timeout=300)))
+            except Exception:
+                failover.append(None)
+    finally:
+        fault.configure(None)
+    for got, ref in zip(failover, want):
+        if got is None or got != ref:
+            lost += 1
+    out['lost_requests'] = lost
+    dead = [r.name for r in rset.snapshot() if r.state == 'dead']
+    out['replicas_killed'] = len(dead)
+
+    # the failed-over request: the master record carrying a failover event
+    rid = next((d['id'] for d in obs.recorder().requests()
+                if any(e.get('ev') == 'failover' for e in d['timeline'])),
+               None)
+    out['stitched_parts'] = 0
+    out['stitched_replicas'] = 0
+    out['failover_hops'] = 0
+    out['dup_events'] = -1
+    if rid is not None:
+        code, body = _get(srv.url + '/debug/requests?id='
+                          + urllib.parse.quote(rid))
+        doc = json.loads(body)
+        st = doc.get('stitched') or {}
+        if st.get('found'):
+            out['stitched_parts'] = st['parts']
+            out['stitched_replicas'] = len(st['replicas'])
+            out['failover_hops'] = sum(
+                1 for a in st['attempts'] if a['outcome'] == 'failover')
+            keys = [(e['ev'], e['t_ms'], e.get('source'),
+                     json.dumps({k: v for k, v in e.items()
+                                 if k not in ('ev', 't_ms', 'source')},
+                                sort_keys=True, default=str))
+                    for e in st['timeline']]
+            out['dup_events'] = len(keys) - len(set(keys))
+
+    # ---- phase 3: staleness fires for the dead replica ------------------
+    time.sleep(0.3)
+    code, text = _get(srv.url + '/metrics')
+    snap = promparse.parse_text(text)
+    stale_dead, stale_live = -1.0, -1.0
+    for key, val in snap['gauges'].items():
+        if not key.startswith('fleet_obs_staleness_s'):
+            continue
+        rep = snap['labels'][key].get('replica')
+        if rep in dead:
+            stale_dead = max(stale_dead, val)
+        elif rep is not None:
+            stale_live = max(stale_live, val)
+    out['staleness_dead_s'] = round(stale_dead, 3)
+    out['staleness_live_s'] = round(stale_live, 3)
+
+    # ---- phase 4: on-demand profile + concurrent 409 --------------------
+    results = []
+
+    def grab(ms):
+        results.append(_get(srv.url + f'/debug/profile?ms={ms}'))
+
+    threads = [threading.Thread(target=grab, args=(300,)) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    codes = sorted(c for c, _ in results)
+    out['profile_busy_409'] = codes == [200, 409]
+    prof = next((json.loads(b) for c, b in results if c == 200), {})
+    out['profile_bytes'] = int(prof.get('bytes', 0))
+    out['profile_files'] = len(prof.get('files', ()))
+    out['profile_window_ms'] = prof.get('window_ms')
+    out['profile_has_artifact_dir'] = bool(
+        prof.get('artifact_dir')) and os.path.isdir(prof['artifact_dir'])
+
+    # ---- phase 5: federation duty cycle vs the <5% budget ---------------
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        fobs.federator.collect()
+        times.append(1e3 * (time.perf_counter() - t0))
+    mean_ms = sum(times) / len(times)
+    out['fed_collect_ms'] = round(mean_ms, 3)
+    out['fed_overhead_pct'] = round(
+        100.0 * (mean_ms / 1e3) / SCRAPE_INTERVAL_S, 3)
+
+    srv.stop()
+    router.close(drain=False)
+    print(json.dumps(out))
+
+
+def run_check(n_requests=6, n_tokens=24, timeout=900):
+    """Run the check in a fresh subprocess; returns the summary dict with
+    the aggregate ``ok`` verdict (importable from bench.py and tests)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child',
+         '--requests', str(n_requests), '--tokens', str(n_tokens)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'fleet obs check child failed:\n{proc.stdout}\n'
+                           f'{proc.stderr}')
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out['ok'] = bool(out['lost_requests'] == 0
+                     and out['replicas_killed'] == 1
+                     and out['counter_families'] > 0
+                     and out['counter_mismatches'] == 0
+                     and out['stitched_parts'] >= 1
+                     and out['stitched_replicas'] == 2
+                     and out['failover_hops'] >= 1
+                     and out['dup_events'] == 0
+                     and out['staleness_dead_s'] > 0
+                     and out['staleness_live_s'] == 0
+                     and out['profile_busy_409']
+                     and out['profile_bytes'] > 0
+                     and out['profile_has_artifact_dir']
+                     and out['fed_overhead_pct'] < OVERHEAD_BUDGET_PCT)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--requests', type=int, default=6)
+    ap.add_argument('--tokens', type=int, default=24)
+    ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.requests, args.tokens)
+        return 0
+    result = run_check(n_requests=args.requests, n_tokens=args.tokens)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -26,11 +26,10 @@ import argparse
 import collections
 import json
 import os
-import re
 import sys
 
 NAMESPACES = ('train', 'serve', 'gen', 'fault', 'ckpt', 'data', 'warmup',
-              'perf', 'slo', 'request', 'server')
+              'perf', 'slo', 'request', 'server', 'fleet', 'host')
 
 
 def _load(path):
@@ -66,75 +65,30 @@ def _namespace(key):
 
 
 # Prometheus text-exposition parsing for --url scrapes ----------------------
+# The parser itself lives in paddle_tpu/observability/promparse.py (the one
+# canonical implementation, shared with the metric federator). It is pure
+# stdlib, so this CLI loads the FILE directly — importing the paddle_tpu
+# package (and with it jax) just to parse text would be wrong for a
+# report tool that may run where jax is absent.
 
-_SAMPLE_RE = re.compile(
-    r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
-_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
-_QUANTILE_TO_PCTL = {'0.5': 'p50', '0.9': 'p90', '0.99': 'p99'}
-
-
-def _unescape_label(v):
-    return (v.replace('\\\\', '\x00').replace('\\"', '"')
-            .replace('\\n', '\n').replace('\x00', '\\'))
+def _promparse():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'paddle_tpu', 'observability',
+        'promparse.py')
+    spec = importlib.util.spec_from_file_location('_pt_promparse', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _scrape(url):
-    """GET <url>/metrics and parse the Prometheus text exposition into a
-    snapshot-shaped dict (counters/gauges/histograms keyed
-    ``name{k=v,...}``), so the rest of the report pipeline is shared with
-    the file path. Summaries come back as histogram rows with p50/p90/p99
-    + sum/count (+ derived mean)."""
-    import urllib.request
-    if not url.rstrip('/').endswith('/metrics'):
-        url = url.rstrip('/') + '/metrics'
-    with urllib.request.urlopen(url, timeout=10) as r:
-        text = r.read().decode('utf-8')
-    types, snap = {}, {'counters': {}, 'gauges': {}, 'histograms': {}}
-    summaries = collections.defaultdict(dict)
-    for line in text.splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        if line.startswith('#'):
-            parts = line.split()
-            if len(parts) >= 4 and parts[1] == 'TYPE':
-                types[parts[2]] = parts[3]
-            continue
-        m = _SAMPLE_RE.match(line)
-        if m is None:
-            continue
-        name, raw_labels, raw_val = m.groups()
-        try:
-            val = float(raw_val)
-        except ValueError:
-            continue
-        if val == int(val):
-            val = int(val)
-        labels = {k: _unescape_label(v)
-                  for k, v in _LABEL_RE.findall(raw_labels or '')}
-        quantile = labels.pop('quantile', None)
-        base, field = name, None
-        if name.endswith('_sum') and types.get(name[:-4]) == 'summary':
-            base, field = name[:-4], 'sum'
-        elif name.endswith('_count') and types.get(name[:-6]) == 'summary':
-            base, field = name[:-6], 'count'
-        elif quantile is not None:
-            field = _QUANTILE_TO_PCTL.get(quantile)
-            if field is None:
-                continue
-        lbl = ','.join(f'{k}={v}' for k, v in sorted(labels.items()))
-        key = f'{base}{{{lbl}}}' if lbl else base
-        if field is not None:
-            summaries[key][field] = val
-        elif types.get(name) == 'gauge':
-            snap['gauges'][key] = val
-        else:
-            snap['counters'][key] = val
-    for key, st in summaries.items():
-        if st.get('count'):
-            st['mean'] = st.get('sum', 0.0) / st['count']
-        snap['histograms'][key] = st
-    return snap
+    """GET <url>/metrics via the shared exposition parser
+    (``observability/promparse.py``) into a snapshot-shaped dict, so the
+    rest of the report pipeline is shared with the file path. Summaries
+    come back as histogram rows with p50/p90/p99 + sum/count (+ derived
+    mean)."""
+    return _promparse().scrape(url)
 
 
 def _group(section):
