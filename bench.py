@@ -678,6 +678,21 @@ def _child_fleet_obs():
     print(json.dumps(fleet_obs_check.run_check()))
 
 
+def _child_prefix():
+    """Prefix-cache gate row: tools/prefix_cache_check.py in a fresh
+    subprocess — >=70% prefill tokens skipped on a repeated
+    shared-system-prompt workload, warm TTFT p99 <= 0.25x cold,
+    byte-identical streams cache-on vs cache-off, zero new compiles on
+    hits, zero cross-tenant page sharing, zero leaked pages after drain
+    + cache clear. The parent banks the prefix_* columns."""
+    _arm_watchdog(900)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import prefix_cache_check
+    print(json.dumps(prefix_cache_check.run_check()))
+
+
 def _child_reqtrace_overhead():
     """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
     GenerationEngine with the telemetry plane attached, run by the parent
@@ -1272,6 +1287,26 @@ def main(fast=False):
         else:
             print(f'fleet obs check failed: {fonote}', file=sys.stderr)
 
+        # prefix-cache gate: repeat shared-system-prompt workload must
+        # skip >=70% prefill tokens, near-zero warm TTFT, byte-identical
+        # output, no new compiles, no cross-tenant sharing, no page leaks
+        px, pxnote = _run_child(['--child-prefix'], 900,
+                                env={'BENCH_CHILD_TIMEOUT': '900'})
+        if px is not None:
+            out['prefix_check_ok'] = bool(px.get('ok'))
+            out['prefix_hit_ttft_p99_ms'] = px.get('warm_ttft_p99_ms')
+            out['prefix_cold_ttft_p99_ms'] = px.get('cold_ttft_p99_ms')
+            out['prefix_ttft_ratio'] = px.get('ttft_ratio')
+            out['prefix_tokens_saved_pct'] = px.get(
+                'prefill_tokens_skipped_pct')
+            out['prefix_new_compiles_on_hits'] = px.get(
+                'new_compiles_on_hits')
+            out['prefix_cross_tenant_shared_pages'] = px.get(
+                'cross_tenant_shared_pages')
+            out['prefix_pages_leaked'] = px.get('pages_leaked')
+        else:
+            print(f'prefix cache check failed: {pxnote}', file=sys.stderr)
+
         # request-tracing overhead A/B on the decode rung: flight recorder
         # + telemetry server enabled vs hard-disabled; budget is <5%
         rt_res = {}
@@ -1407,6 +1442,8 @@ if __name__ == '__main__':
         _child_tenant()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-fleet-obs':
         _child_fleet_obs()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-prefix':
+        _child_prefix()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

@@ -523,7 +523,7 @@ def init_paged_kv_cache(config, num_pages, page_size):
 
 
 def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt,
-                     page_table=None, valid=None):
+                     page_table=None, valid=None, tail=False):
     """Shared KV-cache attention core (used by gpt AND moe_gpt decode):
     writes rows [pos, pos+T) into the caches, attends each q row to cache
     positions <= its absolute index, applies the output projection +
@@ -536,7 +536,11 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt,
     pools ``[N, page_size, H_kv, D]`` (or int8 banks), ``pos`` is a [B]
     i32 vector (slots decode at different depths), and multi-token calls
     are prefills starting at position 0 per slot. Rows past ``valid[b]``
-    are prompt padding and land in the trash page (ops/paged_kv)."""
+    are prompt padding and land in the trash page (ops/paged_kv).
+    ``tail=True`` (static) marks a prefix-cache TAIL prefill: ``pos`` may
+    be nonzero per slot and the q rows must attend KV already resident in
+    earlier pages, so the fresh-rows causal-flash shortcut is invalid and
+    attention runs over the paged cache."""
     from ..ops.weight_only import dequantize_kv, is_weight_only, quantize_kv
     B, T, h = x.shape
     if page_table is not None:
@@ -546,12 +550,14 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt,
         v_cache = paged_write(v_cache, v, page_table, pos, valid)
         from ..ops.flash_attention import (flash_attention,
                                            flash_attention_available)
-        if T > 1 and flash_attention_available(q, k, v, None):
+        if T > 1 and not tail and flash_attention_available(q, k, v, None):
             # multi-token paged calls are engine prefills from position 0:
             # attention over the paged cache equals causal self-attention
             # over the fresh rows (padding rows only feed padding rows,
             # which the engine discards) — run the main flash kernel
-            # instead of gathering the virtual cache
+            # instead of gathering the virtual cache. A TAIL prefill
+            # (tail=True) starts mid-sequence and must see the cached
+            # prefix pages, so it takes the paged path below.
             a = flash_attention(q, k, v, causal=True).reshape(B, T, h)
         else:
             a = paged_attention(q, k_cache, v_cache, page_table, pos,
@@ -607,7 +613,7 @@ def cached_attention(x, q, k, v, k_cache, v_cache, pos, proj_w, proj_b, cdt,
 
 
 def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
-                  valid=None):
+                  valid=None, tail=False):
     """One block over a [B, T, H] slice starting at ``pos``."""
     cdt = jnp.dtype(config.dtype)
     y = _layer_norm(x, bp['ln1_g'], bp['ln1_b']).astype(cdt)
@@ -615,7 +621,7 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
                          config.kv_heads)
     x, k_cache, v_cache = cached_attention(
         x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt,
-        page_table=page_table, valid=valid)
+        page_table=page_table, valid=valid, tail=tail)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     x = x + _block_mlp(bp, y, cdt) + bp['out_b'].astype(cdt)
     return x, k_cache, v_cache
@@ -633,6 +639,10 @@ def paged_forward_with_cache(params, tokens, cache, pos, config,
     pos_v = jnp.asarray(pos, jnp.int32).reshape(-1)
     page_table = cache['page_table']
     valid = cache.get('valid')
+    # STATIC marker set by the prefix-cache tail-prefill path (the engine
+    # builds the cache dict in-trace, so a plain bool survives): q rows
+    # must attend KV resident in earlier pages, not just the fresh rows
+    tail = bool(cache.get('tail', False))
     ppos = jnp.clip(pos_v[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
                     0, config.max_seq_len - 1)            # [B, T]
     x = (wo_take(params['wte'], tokens)
@@ -642,7 +652,7 @@ def paged_forward_with_cache(params, tokens, cache, pos, config,
         xx = carry
         bp, kc, vc = inp
         xx, kc, vc = block(bp, xx, kc, vc, pos_v, config,
-                           page_table=page_table, valid=valid)
+                           page_table=page_table, valid=valid, tail=tail)
         return xx, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(

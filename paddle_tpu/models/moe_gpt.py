@@ -277,7 +277,7 @@ def init_kv_cache(config: 'MoEConfig', batch):
 
 
 def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
-                  valid=None):
+                  valid=None, tail=False):
     cdt = jnp.dtype(config.dtype)
     B, T, h = x.shape
     nh, hd = config.num_heads, config.head_dim
@@ -285,7 +285,7 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
     q, k, v = _block_qkv(bp, y, nh, hd, cdt, config.kv_heads)
     x, k_cache, v_cache = cached_attention(
         x, q, k, v, k_cache, v_cache, pos, bp['proj_w'], bp['proj_b'], cdt,
-        page_table=page_table, valid=valid)
+        page_table=page_table, valid=valid, tail=tail)
     y = _layer_norm(x, bp['ln2_g'], bp['ln2_b']).astype(cdt)
     ff, _ = moe_ffn(y, bp['gate_w'].astype(cdt), _c(bp['w_in'], cdt),
                     _c(bp['w_out'], cdt),

@@ -29,6 +29,7 @@ Conventions shared by every consumer:
 """
 import threading
 
+import jax
 import jax.numpy as jnp
 
 from .weight_only import init_kv_bank, is_weight_only, quantize_kv
@@ -55,17 +56,25 @@ def init_paged_pool(num_layers, num_pages, page_size, kv_heads, head_dim,
 
 
 class PageAllocator:
-    """Host-side free-list over pages ``1..num_pages-1`` (page 0 reserved).
+    """Host-side REFCOUNTED free-list over pages ``1..num_pages-1`` (page 0
+    reserved — it is never handed out and never re-enters the free list).
 
     All-or-nothing ``alloc(n)``: a request either gets all n pages or None,
-    so a half-admitted sequence never strands pages. Thread-safe (the
-    engine's scheduler thread and stats readers may race)."""
+    so a half-admitted sequence never strands pages. A fresh allocation
+    carries refcount 1; ``retain()`` lets a second holder (a live slot
+    sharing a cached prefix page, or the prefix cache itself) pin the same
+    page, and ``free()`` decrements — the page returns to the free list
+    only at refcount zero. Freeing a page that holds no references (a
+    double free) raises instead of silently corrupting the pool.
+    Thread-safe (the engine's scheduler thread and stats readers may
+    race); this lock is a LEAF — never call out while holding it."""
 
     def __init__(self, num_pages):
         if num_pages < 2:
             raise ValueError('num_pages must be >= 2 (page 0 is reserved)')
         self.num_pages = int(num_pages)
         self._free = list(range(self.num_pages - 1, 0, -1))  # pop() -> low ids
+        self._refs = {}          # page id -> live reference count (>= 1)
         self._lock = threading.Lock()
 
     @property
@@ -77,8 +86,14 @@ class PageAllocator:
     def used_pages(self):
         return (self.num_pages - 1) - self.free_pages
 
+    def refcount(self, page):
+        """Current reference count of ``page`` (0 when on the free list)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
     def alloc(self, n):
-        """-> list of n page ids, or None if the pool can't cover them."""
+        """-> list of n page ids (each at refcount 1), or None if the pool
+        can't cover them."""
         n = int(n)
         if n < 0:
             raise ValueError('alloc(n) needs n >= 0')
@@ -86,17 +101,42 @@ class PageAllocator:
             if n > len(self._free):
                 return None
             out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._refs[p] = 1
         return out
 
+    def retain(self, pages):
+        """Add one reference to each already-allocated page (page sharing:
+        a slot mapping cached prefix pages, or the cache publishing a
+        slot's pages). Retaining a free or invalid page raises — sharing
+        an unowned page would alias whoever allocates it next."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if not 0 < p < self.num_pages:
+                    raise ValueError(f'retain() of invalid page id {p}')
+                if p not in self._refs:
+                    raise ValueError(f'retain() of unallocated page {p}')
+            for p in pages:
+                self._refs[int(p)] += 1
+
     def free(self, pages):
+        """Drop one reference per page; a page returns to the free list at
+        refcount zero. Raises on page 0, out-of-range ids, and double
+        frees (the trash page can therefore never reach the free list)."""
         with self._lock:
             for p in pages:
                 p = int(p)
                 if not 0 < p < self.num_pages:
                     raise ValueError(f'free() of invalid page id {p}')
-                if p in self._free:
+                if p not in self._refs:
                     raise ValueError(f'double free of page {p}')
-                self._free.append(p)
+            for p in pages:
+                p = int(p)
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._free.append(p)
 
 
 def flat_write_indices(page_table, pos, n_rows, page_size, valid=None):
@@ -150,6 +190,32 @@ def paged_write(pages, rows, page_table, pos, valid=None):
     flat = pages.reshape(n * ps, h, d)
     flat = flat.at[idx].set(rows.reshape(b * t, h, d).astype(pages.dtype))
     return flat.reshape(n, ps, h, d)
+
+
+def copy_page(pool, src, dst):
+    """Copy-on-write primitive: duplicate physical page ``src`` into
+    ``dst`` across every pool plane (k and v, all layers; int8 banks copy
+    both the int8 and scale planes). ``pool`` is the engine's full paged
+    cache pytree ``{'k': [L, N, ps, H, D], 'v': ...}``.
+
+    Compiled ONCE per pool signature (src/dst are traced scalars) and the
+    input pool is donated, so a divergence mid-page costs one tiny
+    executable reused forever — never a retrace per COW, which is what
+    keeps "zero new compiles on cache hits" true for the prefix cache."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return _copy_page_jit(pool, src, dst)
+
+
+def _copy_page_impl(pool, src, dst):
+    def one(arr):
+        # every pool plane is page-indexed on axis 1 ([L, N, ...])
+        row = jax.lax.dynamic_index_in_dim(arr, src, axis=1, keepdims=True)
+        return jax.lax.dynamic_update_slice_in_dim(arr, row, dst, axis=1)
+    return jax.tree_util.tree_map(one, pool)
+
+
+_copy_page_jit = jax.jit(_copy_page_impl, donate_argnums=(0,))
 
 
 def gather_virtual(pages, page_table):

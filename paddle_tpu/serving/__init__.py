@@ -31,6 +31,12 @@ manifests (swap-in is seconds, zero retraces), interactive/batch
 priority lanes with SLO-driven batch shedding, and per-tenant quotas +
 ``request.*`` accounting. The fleet router targets hosted models as
 ``submit(..., target='model@host')``.
+
+Prefix caching (``prefix_cache.py``): the ``GenerationEngine`` can keep
+finished sequences' paged-KV pages resident in a tenant-namespaced
+``PrefixCache`` — a repeat prefix is admitted with its pages pre-mapped
+(refcounted sharing + copy-on-write), prefilling only the uncached tail
+and skipping prefill entirely on an exact ``(prompt, seed)`` repeat.
 """
 from .bucketing import (bucket_for, bucket_sizes, input_signature,  # noqa: F401
                         pad_rows)
@@ -40,6 +46,7 @@ from .errors import (DeadlineExceededError, EngineClosedError,  # noqa: F401
 from .metrics import ServingStats  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .generation import GenerationEngine, GenerationFuture  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .fleet import (Autoscaler, FleetRouter, Replica,  # noqa: F401
                     ReplicaSet)
 from .host import (HostedModel, ModelHost, get_host,  # noqa: F401
@@ -47,7 +54,7 @@ from .host import (HostedModel, ModelHost, get_host,  # noqa: F401
 
 __all__ = [
     'InferenceEngine', 'ServingStats', 'BucketCompileCache',
-    'GenerationEngine', 'GenerationFuture',
+    'GenerationEngine', 'GenerationFuture', 'PrefixCache',
     'ReplicaSet', 'FleetRouter', 'Autoscaler', 'Replica',
     'ModelHost', 'HostedModel', 'get_host', 'resolve_target',
     'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',

@@ -35,8 +35,21 @@ calls, ``gen.*`` metrics in the observability registry, and warmup
 manifest capture (``gen_prefill`` / ``gen_decode`` entries) so a new
 process prebuilds both executables before traffic.
 
+With ``prefix_cache=True`` the engine indexes finished sequences' pages
+in a :class:`~.prefix_cache.PrefixCache` (tenant-namespaced trie over
+page-aligned chunks): a later request with a cached prefix is admitted
+with those pages pre-mapped and prefills only the uncached tail through
+the SAME prefill executable (the tail start position is a traced
+argument — zero new traces, provable via ``_trace_count``); an exact
+``(prompt, seed)`` repeat skips the prefill device call entirely and
+replays the recorded first token (near-zero TTFT). Shared pages are
+refcounted by the allocator; mid-page divergence copies the page
+(copy-on-write) before any write, and cache residency is released LRU
+before any live slot is ever evicted for pages.
+
 Env knobs: ``PADDLE_TPU_GEN_SLOTS`` (default 8),
-``PADDLE_TPU_GEN_PAGE_SIZE`` (default 128, clamped to max_seq_len).
+``PADDLE_TPU_GEN_PAGE_SIZE`` (default 128, clamped to max_seq_len),
+``PADDLE_TPU_GEN_PREFIX`` (=1 enables the prefix cache by default).
 """
 import itertools
 import os
@@ -54,9 +67,11 @@ from .. import observability as _obs
 from ..models import gpt as _gpt
 from ..ops import paged_kv as _pkv
 from .errors import DeadlineExceededError, EngineClosedError, QueueFullError
+from .prefix_cache import PrefixCache
 
 ENV_SLOTS = 'PADDLE_TPU_GEN_SLOTS'
 ENV_PAGE_SIZE = 'PADDLE_TPU_GEN_PAGE_SIZE'
+ENV_PREFIX = 'PADDLE_TPU_GEN_PREFIX'
 
 _HIST_WINDOW = 4096
 
@@ -89,6 +104,12 @@ class GenerationFuture:
     def _count(self):
         with self._cv:
             return len(self._tokens)
+
+    def _snapshot(self, n):
+        """First ``n`` emitted tokens (the prefix-cache publisher's view of
+        what the KV rows past the prompt hold)."""
+        with self._cv:
+            return [int(t) for t in self._tokens[:n]]
 
     def _subscribe(self, fn):
         """Register ``fn(kind, *args)`` invoked OUTSIDE the future's lock:
@@ -170,13 +191,14 @@ class GenerationFuture:
 
 class _Request:
     __slots__ = ('prompt', 'eff_max_new', 'seed', 'future', 'enqueue_t',
-                 'deadline_t', 'evictions', 'ttft_noted', 'rec')
+                 'deadline_t', 'evictions', 'ttft_noted', 'rec', 'tenant')
 
     def __init__(self, prompt, eff_max_new, seed, future, enqueue_t,
-                 deadline_t, rec=None):
+                 deadline_t, rec=None, tenant='default'):
         self.prompt = prompt
         self.eff_max_new = eff_max_new
         self.seed = seed
+        self.tenant = tenant
         self.future = future
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
@@ -188,15 +210,22 @@ class _Request:
 
 
 class _Slot:
-    __slots__ = ('req', 'pos', 'last_tok', 'produced', 'table', 'admit_seq')
+    __slots__ = ('req', 'pos', 'last_tok', 'produced', 'table', 'admit_seq',
+                 'start', 'cow', 'first_tok')
 
-    def __init__(self, req, table, admit_seq):
+    def __init__(self, req, table, admit_seq, start=0, cow=None,
+                 first_tok=None):
         self.req = req
         self.pos = len(req.prompt)      # next KV write position
         self.last_tok = 0
         self.produced = 0
         self.table = table              # np [p_max] i32, 0 = unallocated
         self.admit_seq = admit_seq
+        self.start = start              # first prompt row prefill computes
+                                        # (cached rows < start are mapped)
+        self.cow = cow                  # pending (src, dst) page copy
+        self.first_tok = first_tok      # full prefix hit: replay this token
+                                        # instead of running prefill
 
 
 def _resolve_generation_model(net, config, forward_fn):
@@ -242,7 +271,8 @@ class GenerationEngine:
                  top_k=None, top_p=None, eos_id=None, queue_capacity=64,
                  default_deadline_ms=None, breaker=None, autostart=True,
                  forward_fn=None, clock=None, precision=None,
-                 telemetry_port=None):
+                 telemetry_port=None, prefix_cache=None,
+                 prefix_cache_pages=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -299,6 +329,14 @@ class GenerationEngine:
 
         self._pool = _gpt.init_paged_kv_cache(cfg, self.num_pages, ps)
         self._alloc = _pkv.PageAllocator(self.num_pages)
+        # prefix cache: opt-in (constructor flag, giving it a residency
+        # bound, or the env knob) — page accounting changes when finished
+        # sequences stay resident, so it is never silently enabled
+        if prefix_cache is None:
+            prefix_cache = (prefix_cache_pages is not None
+                            or _env_int(ENV_PREFIX, 0) > 0)
+        self._prefix = (PrefixCache(self._alloc, ps, prefix_cache_pages)
+                        if prefix_cache else None)
         self._slots = [None] * self.num_slots
         self._queue = deque()
         self._lock = threading.Lock()
@@ -316,7 +354,10 @@ class GenerationEngine:
         self._start_t = self._clock()
         self._n = {k: 0 for k in ('submitted', 'completed', 'rejected',
                                   'expired', 'failed', 'evictions',
-                                  'tokens', 'prefills', 'steps')}
+                                  'tokens', 'prefills', 'steps',
+                                  'prefix_hits', 'prefix_misses',
+                                  'prefix_full_hits', 'prefix_tokens_saved',
+                                  'prefix_evictions')}
         self._make_metrics()
         # readiness + optional telemetry plane (same contract as
         # InferenceEngine: /readyz = warm AND breaker closed AND queue
@@ -360,6 +401,12 @@ class GenerationEngine:
                     'failed')}
         self._c['evictions'] = mk_c('gen.evictions')
         self._c['tokens'] = mk_c('gen.tokens')
+        # gen.prefix.*: the prefix-cache surface fleetobs federates
+        self._c['prefix_hits'] = mk_c('gen.prefix.hits')
+        self._c['prefix_misses'] = mk_c('gen.prefix.misses')
+        self._c['prefix_full_hits'] = mk_c('gen.prefix.full_hits')
+        self._c['prefix_tokens_saved'] = mk_c('gen.prefix.tokens_saved')
+        self._c['prefix_evictions'] = mk_c('gen.prefix.evictions')
         self._h = {'prefill': mk_h('gen.prefill_ms'),
                    'step': mk_h('gen.decode_step_ms'),
                    'ttft': mk_h('gen.ttft_ms'),
@@ -370,7 +417,8 @@ class GenerationEngine:
                    # wait is never under-reported.
                    'queue_wait': mk_h('serve.queue_wait_ms')}
         self._g = {'occupancy': mk_g('gen.slot_occupancy'),
-                   'pages': mk_g('gen.page_utilization')}
+                   'pages': mk_g('gen.page_utilization'),
+                   'prefix_pages': mk_g('gen.prefix.cached_pages')}
 
     def _note(self, key, n=1):
         self._n[key] += n
@@ -381,8 +429,16 @@ class GenerationEngine:
     def _update_gauges_locked(self):
         active = sum(1 for s in self._slots if s is not None)
         self._g['occupancy'].set(active / max(self.num_slots, 1))
+        # page 0 (the reserved trash page) is excluded from the
+        # denominator: a fully loaded pool reads 1.0
         usable = max(self.num_pages - 1, 1)
         self._g['pages'].set(self._alloc.used_pages / usable)
+        if self._prefix is not None:
+            self._g['prefix_pages'].set(self._prefix.cached_pages)
+            ev = self._prefix.stats()['evictions']
+            delta = ev - self._n['prefix_evictions']
+            if delta > 0:
+                self._note('prefix_evictions', delta)
 
     # ---- compiled fns ----------------------------------------------------
     def _build_fns(self):
@@ -402,15 +458,23 @@ class GenerationEngine:
                                     key=key)[0]
             return jax.vmap(one)(lg, seeds, positions)
 
-        def prefill(params, pool, prompt, valid, page_table, seed):
+        def prefill(params, pool, prompt, start, valid, page_table, seed):
             self._trace_count += 1      # trace-time side effect
+            # 'tail': True (a STATIC pytree key — the dict never crosses a
+            # jit boundary) routes T>1 attention through the paged kernel
+            # so rows past ``start`` attend prefix pages written by an
+            # earlier sequence. ONE executable serves cold prefills
+            # (start=0) and cached-prefix tails alike: start is traced,
+            # so prefix-cache hits never trace or compile anything new.
             cache = {'k': pool['k'], 'v': pool['v'],
-                     'page_table': page_table, 'valid': valid}
-            pos0 = jnp.zeros((prompt.shape[0],), jnp.int32)
+                     'page_table': page_table, 'valid': valid, 'tail': True}
+            pos0 = start.astype(jnp.int32)
             logits, cache = fwd(params, prompt, cache, pos0, cfg,
                                 last_only=True)
+            # absolute position start+valid-1: the sampling key of the
+            # prompt's last row must not depend on how much was cached
             tok = sample_rows(logits[:, 0], seed,
-                              valid.astype(jnp.int32) - 1)
+                              pos0 + valid.astype(jnp.int32) - 1)
             return tok, {'k': cache['k'], 'v': cache['v']}
 
         def step(params, pool, tok, pos, page_table, seeds):
@@ -453,6 +517,12 @@ class GenerationEngine:
         for e in self._manifest_entries():
             man.add(e)
         report = _warmup_mod.prebuild(man, generation=self)
+        if self._prefix is not None:
+            # pre-compile the COW copy executable too — a trash-page
+            # self-copy is a no-op on real data, and without it the first
+            # mid-page cache hit would pay the compile in its TTFT
+            with self._lock:
+                self._pool = _pkv.copy_page(self._pool, 0, 0)
         self._warmed = True          # flips the /readyz warm check
         return report
 
@@ -497,6 +567,9 @@ class GenerationEngine:
             self._drain_inline()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._prefix is not None:
+            with self._lock:
+                self._prefix.clear()
         _obs.remove_readiness(self._probe_name)
         self.telemetry.stop()
 
@@ -509,11 +582,14 @@ class GenerationEngine:
 
     # ---- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, deadline_ms=None, seed=0,
-               *, _record=None, _enqueue_t=None, _deadline_t=_UNSET):
+               tenant='default', *, _record=None, _enqueue_t=None,
+               _deadline_t=_UNSET):
         """Enqueue one sequence. ``prompt`` is a 1-D token id sequence of
         length 1..prefill_width; returns a ``GenerationFuture``. Tokens
         stop at ``eos_id`` (emitted), ``max_new_tokens``, or the context
         window (a prompt of exactly max_seq_len still yields one token).
+        ``tenant`` namespaces the prefix cache: KV pages are only ever
+        reused within one tenant's own traffic.
 
         The underscore params are the fleet router's resubmission hooks:
         a failed-over request keeps its original ``RequestRecord``,
@@ -562,7 +638,7 @@ class GenerationEngine:
             rec.finish('expired', err)
             raise err
         req = _Request(arr, eff, int(seed) & 0xFFFFFFFF, fut, enqueue_t,
-                       deadline_t, rec=rec)
+                       deadline_t, rec=rec, tenant=str(tenant))
         try:
             with self._cv:
                 if self._closed:
@@ -644,17 +720,51 @@ class GenerationEngine:
                 req.future._finish(err)
                 self._note('failed')
                 continue
-            pages = self._alloc.alloc(need)
+            # longest cached prefix: matched full pages arrive retained
+            # (this slot's references); the COW source page stays owned by
+            # the cache and is copied into a private page before any write
+            hit = (self._prefix.acquire(req.tenant, req.prompt, req.seed)
+                   if self._prefix is not None else None)
+            shared = hit['pages'] if hit else []
+            cow_src = hit['cow'] if hit else None
+            # the COW destination is one of the `need` logical pages and
+            # comes out of the fresh allocation (pages[0] below)
+            fresh = need - len(shared)
+            pages = self._alloc_with_release_locked(fresh)
             if pages is None:
+                if shared:
+                    self._alloc.free(shared)    # undo; re-acquired on retry
                 break       # active slots will free pages; retry next round
             self._queue.popleft()
             table = np.zeros((self.p_max,), np.int32)
-            table[:need] = pages
+            n_shared = len(shared)
+            table[:n_shared] = shared
+            cow = None
+            if cow_src is not None:
+                cow = (cow_src, pages[0])
+                table[n_shared] = pages[0]
+                pages = pages[1:]
+            if pages:
+                table[need - len(pages):need] = pages
             waited_ms = max(0.0, (now - req.enqueue_t) * 1e3)
             self._h['queue_wait'].observe(waited_ms)
             req.rec.note('admit', slot=free_idx, pages=need,
                          waited_ms=round(waited_ms, 3))
-            self._slots[free_idx] = _Slot(req, table, self._admit_seq)
+            start, first_tok = 0, None
+            if hit is not None:
+                start = hit['match']
+                first_tok = hit['next_tok']
+                self._note('prefix_hits')
+                self._note('prefix_tokens_saved', start)
+                if first_tok is not None:
+                    self._note('prefix_full_hits')
+                req.rec.note('prefix_hit', tokens=start,
+                             full=first_tok is not None)
+            elif self._prefix is not None:
+                self._note('prefix_misses')
+            self._slots[free_idx] = _Slot(req, table, self._admit_seq,
+                                          start=start, cow=cow,
+                                          first_tok=first_tok)
             self._admit_seq += 1
             out.append(free_idx)
         if out:
@@ -667,9 +777,33 @@ class GenerationEngine:
             return
         req = slot.req
         t0 = len(req.prompt)
+        if slot.cow is not None:
+            # copy-on-write: duplicate the shared mid-page before this
+            # sequence writes into it (compiled once ever — see copy_page)
+            src, dst = slot.cow
+            slot.cow = None
+            self._pool = _pkv.copy_page(self._pool, src, dst)
+        if slot.first_tok is not None:
+            # full prefix hit: every prompt row is already in mapped pages
+            # and the donor recorded the first sampled token for this seed
+            # — no device call at all, TTFT is pure admission latency
+            tok = slot.first_tok
+            req.rec.note('prefill_skip', slot=idx, prompt_len=t0)
+            with self._cv:
+                if self._slots[idx] is not slot:
+                    return
+                slot.last_tok = tok
+                self._emit_locked(slot, tok)
+                if self._slot_finished(slot, tok):
+                    self._finish_slot_locked(idx)
+                self._update_gauges_locked()
+            return
+        start = slot.start
+        tail = t0 - start               # uncached rows to prefill
         prompt = np.zeros((1, self.prefill_width), np.int32)
-        prompt[0, :t0] = req.prompt
-        valid = np.asarray([t0], np.int32)
+        prompt[0, :tail] = req.prompt[start:]
+        startv = np.asarray([start], np.int32)
+        valid = np.asarray([tail], np.int32)
         table = slot.table[None].copy()
         seed = np.asarray([req.seed], np.uint32)
         self._maybe_record()
@@ -679,11 +813,11 @@ class GenerationEngine:
         def dev():
             fault.inject('gen.step')
             tok, pool = pf(self._params, self._pool, jnp.asarray(prompt),
-                           jnp.asarray(valid), jnp.asarray(table),
-                           jnp.asarray(seed))
+                           jnp.asarray(startv), jnp.asarray(valid),
+                           jnp.asarray(table), jnp.asarray(seed))
             return int(np.asarray(tok)[0]), pool
 
-        req.rec.note('prefill', slot=idx, prompt_len=t0)
+        req.rec.note('prefill', slot=idx, prompt_len=t0, start=start)
         try:
             with _obs.span('gen.prefill', slot=idx, prompt_len=t0,
                            req_id=req.rec.rid):
@@ -788,8 +922,25 @@ class GenerationEngine:
             self._alloc.free(pages)
         self._slots[idx] = None
 
+    def _publish_locked(self, slot):
+        """Index a retiring/evicted slot's written pages in the prefix
+        cache (called BEFORE the slot's own references are freed, so every
+        published page is still live when the cache retains it)."""
+        if self._prefix is None:
+            return
+        req = slot.req
+        t0 = len(req.prompt)
+        # KV row p >= t0 holds the (p - t0)-th generated token; the final
+        # sampled token was emitted but never written, so rows == slot.pos
+        gen = req.future._snapshot(slot.pos - t0)
+        tokens = [int(t) for t in req.prompt] + gen
+        first = (req.future._snapshot(1) or [None])[0]
+        self._prefix.publish(req.tenant, tokens, slot.table, slot.pos,
+                             prompt_len=t0, seed=req.seed, first_tok=first)
+
     def _finish_slot_locked(self, idx):
         slot = self._slots[idx]
+        self._publish_locked(slot)
         self._free_slot_locked(idx)
         slot.req.rec.note('retire', produced=slot.produced,
                           evictions=slot.req.evictions)
@@ -815,7 +966,8 @@ class GenerationEngine:
             if li >= self.p_max or slot.table[li] != _pkv.TRASH_PAGE:
                 continue
             while True:
-                pg = self._alloc.alloc(1)
+                # cold cache residency yields before any live slot does
+                pg = self._alloc_with_release_locked(1)
                 if pg is not None:
                     slot.table[li] = pg[0]
                     break
@@ -835,6 +987,18 @@ class GenerationEngine:
                     break       # self-preempted; re-admitted when pages free
             # fall through to the next slot whether or not i survived
 
+    def _alloc_with_release_locked(self, n):
+        """``alloc(n)``, releasing LRU prefix-cache residency on failure
+        until the allocation fits or the cache is dry. A released page
+        only reaches the free list at refcount zero, so keep releasing
+        while the cache still holds anything."""
+        pages = self._alloc.alloc(n)
+        while pages is None and self._prefix is not None:
+            if not self._prefix.release_lru(n):
+                break
+            pages = self._alloc.alloc(n)
+        return pages
+
     def _pick_victim_locked(self):
         best, best_seq = None, -1
         for i, slot in enumerate(self._slots):
@@ -847,6 +1011,9 @@ class GenerationEngine:
     def _evict_locked(self, idx):
         slot = self._slots[idx]
         req = slot.req
+        # publish what the victim already computed: its re-admission (and
+        # anyone sharing its prefix) prefills only past the cached rows
+        self._publish_locked(slot)
         self._free_slot_locked(idx)
         req.evictions += 1
         req.rec.note('evict', count=req.evictions)
@@ -864,6 +1031,9 @@ class GenerationEngine:
                 if slot is not None:
                     failed.append(slot.req)
                     self._free_slot_locked(i)
+            if self._prefix is not None:
+                # cached KV lives in the pool being rebuilt: drop it all
+                self._prefix.clear()
             self._pool = _gpt.init_paged_kv_cache(
                 self.config, self.num_pages, self.page_size)
             self._update_gauges_locked()
@@ -872,6 +1042,34 @@ class GenerationEngine:
             r.rec.finish('error', exc)
             if r.future._finish(exc):
                 self._note('failed')
+
+    # ---- prefix cache knobs ----------------------------------------------
+    @property
+    def prefix_cache(self):
+        """The engine's :class:`~.prefix_cache.PrefixCache` (None when
+        disabled)."""
+        return self._prefix
+
+    def set_prefix_capacity(self, capacity_pages):
+        """Bound prefix-cache residency to ``capacity_pages`` pool pages
+        (None lifts the bound) — the ModelHost per-model knob. Evicts LRU
+        leaves immediately when already over."""
+        if self._prefix is None:
+            return
+        with self._lock:
+            self._prefix.set_capacity(capacity_pages)
+            self._update_gauges_locked()
+
+    def clear_prefix_cache(self):
+        """Release every cached page back toward the allocator (pages also
+        mapped by live slots free when those slots retire). Returns the
+        number of entries dropped."""
+        if self._prefix is None:
+            return 0
+        with self._lock:
+            n = self._prefix.clear()
+            self._update_gauges_locked()
+            return n
 
     # ---- observability ---------------------------------------------------
     def stats(self):
@@ -907,4 +1105,6 @@ class GenerationEngine:
             'warmed': self._warmed,
             'uptime_s': round(elapsed, 3),
         })
+        out['prefix'] = (self._prefix.stats()
+                         if self._prefix is not None else None)
         return out

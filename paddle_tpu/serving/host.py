@@ -151,10 +151,10 @@ class HostedModel:
                  'footprint_bytes', 'reserved_bytes', 'last_used', 'state',
                  'pinned', 'breaker', 'inflight', 'batch_inflight',
                  'shed_batch', 'rule_name', 'swap_ins', 'evictions',
-                 'input_spec')
+                 'input_spec', 'prefix_cache_pages')
 
     def __init__(self, name, factory, *, pinned=False, input_spec=None,
-                 footprint_bytes=0, breaker=None):
+                 footprint_bytes=0, breaker=None, prefix_cache_pages=None):
         self.name = name
         self.factory = factory
         self.kind = None             # 'infer' | 'gen', set at materialize
@@ -175,6 +175,10 @@ class HostedModel:
         self.swap_ins = 0
         self.evictions = 0
         self.input_spec = input_spec
+        # residency bound for a generation engine's prefix cache, re-applied
+        # on every swap-in (the host's lever to keep cached KV pages from
+        # crowding the HBM watermark)
+        self.prefix_cache_pages = prefix_cache_pages
 
     @property
     def engine_label(self):
@@ -186,8 +190,13 @@ class HostedModel:
         return eng._stats.labels['engine']
 
     def describe(self):
+        pc = (getattr(self.engine, 'prefix_cache', None)
+              if self.engine is not None else None)
         return {'state': self.state, 'kind': self.kind,
                 'footprint_bytes': self.footprint_bytes,
+                'prefix_cache_pages': self.prefix_cache_pages,
+                'prefix_cached_pages': (pc.cached_pages
+                                        if pc is not None else 0),
                 'inflight': self.inflight,
                 'batch_inflight': self.batch_inflight,
                 'shed_batch': self.shed_batch,
@@ -327,7 +336,7 @@ class ModelHost:
 
     # ---- admission / deploy ----------------------------------------------
     def deploy(self, name, factory, *, footprint_bytes=None, input_spec=None,
-               pin=False, warm=True, breaker=None):
+               pin=False, warm=True, breaker=None, prefix_cache_pages=None):
         """Admit one model onto the host.
 
         ``factory`` is a zero-arg callable building the model's engine —
@@ -335,8 +344,11 @@ class ModelHost:
         repeatable. ``footprint_bytes`` pre-gates admission before the
         engine is even built (otherwise the first deploy builds, measures,
         and then enforces the watermark); ``pin=True`` exempts the model
-        from LRU eviction. Raises :class:`HBMAdmissionError` when the
-        model cannot fit even after evicting every cold model."""
+        from LRU eviction; ``prefix_cache_pages`` caps a generation
+        engine's prefix-cache residency (applied after every build, so the
+        bound survives evict/swap-in cycles). Raises
+        :class:`HBMAdmissionError` when the model cannot fit even after
+        evicting every cold model."""
         try:
             fault.inject('host.admit')
         except InjectedFault:
@@ -350,7 +362,8 @@ class ModelHost:
                                  f'{self.name}')
             m = HostedModel(name, factory, pinned=pin, input_spec=input_spec,
                             footprint_bytes=footprint_bytes or 0,
-                            breaker=breaker)
+                            breaker=breaker,
+                            prefix_cache_pages=prefix_cache_pages)
             self._models[name] = m
         try:
             if m.footprint_bytes:
@@ -380,6 +393,8 @@ class ModelHost:
         try:
             m.kind = 'gen' if isinstance(engine, GenerationEngine) \
                 else 'infer'
+            if m.kind == 'gen' and m.prefix_cache_pages is not None:
+                engine.set_prefix_capacity(m.prefix_cache_pages)
             if m.warmth:
                 # swap-in: restore the retained executables — zero
                 # retraces, zero new compiles
@@ -666,7 +681,7 @@ class ModelHost:
                     fut = engine.submit(args[0] if args else (),
                                         max_new_tokens=max_new_tokens,
                                         seed=seed, deadline_ms=deadline_ms,
-                                        _record=rec)
+                                        tenant=tenant, _record=rec)
                 else:
                     fut = engine.submit(*args, deadline_ms=deadline_ms,
                                         _record=rec)

@@ -180,7 +180,8 @@ def _prebuild_generation(engine, entry):
         compiled = pf.lower(
             params, pool,
             _struct((1, engine.prefill_width), np.int32),
-            _struct((1,), np.int32),
+            _struct((1,), np.int32),    # start (prefix-cache tail offset)
+            _struct((1,), np.int32),    # valid
             _struct((1, engine.p_max), np.int32),
             _struct((1,), np.uint32)).compile()
         _perf_analyze('gen.prefill', compiled)

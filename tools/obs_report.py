@@ -28,8 +28,9 @@ import json
 import os
 import sys
 
-NAMESPACES = ('train', 'serve', 'gen', 'fault', 'ckpt', 'data', 'warmup',
-              'perf', 'slo', 'request', 'server', 'fleet', 'host')
+NAMESPACES = ('train', 'serve', 'gen.prefix', 'gen', 'fault', 'ckpt',
+              'data', 'warmup', 'perf', 'slo', 'request', 'server', 'fleet',
+              'host')
 
 
 def _load(path):
@@ -55,13 +56,18 @@ def _load(path):
 
 def _namespace(key):
     base = key.split('{', 1)[0]
-    ns = base.split('.', 1)[0]
-    if ns in NAMESPACES:
-        return ns
+    # longest match first: 'gen.prefix.hits' belongs to gen.prefix, not gen
+    for ns in NAMESPACES:
+        if base == ns or base.startswith(ns + '.'):
+            return ns
     # Prometheus exposition mangles dots to underscores; a scraped key is
     # 'serve_queue_wait_ms', not 'serve.queue_wait_ms'
-    ns = base.split('_', 1)[0]
-    return ns if ns in NAMESPACES else 'other'
+    mangled = base.replace('.', '_')
+    for ns in NAMESPACES:
+        pre = ns.replace('.', '_')
+        if mangled == pre or mangled.startswith(pre + '_'):
+            return ns
+    return 'other'
 
 
 # Prometheus text-exposition parsing for --url scrapes ----------------------

@@ -1,0 +1,196 @@
+"""Prefix-cache / KV-reuse acceptance gate: repeat prefixes must skip
+prefill, reuse pages safely, and change nothing observable. Runnable
+anywhere (CPU-safe, fresh subprocess).
+
+Workload: one GenerationEngine with the prefix cache enabled serves two
+waves of the same request set — 12 prompts sharing a 96-token system
+prefix with unique 24-token suffixes. The cold wave populates the cache
+(later cold requests already partial-hit the shared prefix); the warm
+wave resubmits the identical ``(prompt, seed)`` pairs, which must ride
+the full-hit skip-prefill path. A cache-off engine replays the cold wave
+as the semantics reference.
+
+Checks (all must hold for ``ok``):
+  1. prefill_tokens_skipped_pct >= 70 on the warm wave — the cache, not
+     the prefill executable, supplies the shared-prefix KV.
+  2. warm TTFT p99 <= 0.25x cold TTFT p99 (near-zero TTFT on repeats).
+  3. byte-identical token streams: cache-on cold == cache-off, and
+     warm == cold (reuse never changes sampled output).
+  4. zero new compiles on hits: ``_trace_count`` frozen across the warm
+     wave (and the whole run stays at the 2-executable invariant).
+  5. zero cross-tenant page sharing: the same prompt under two tenants
+     never maps a common physical page (``debug_pages`` sets disjoint).
+  6. no page leaks: after drain + ``clear_prefix_cache()`` the allocator
+     is back to ``num_pages - 1`` free pages (page 0 stays reserved).
+
+Emits one JSON line, e.g.:
+  {"prefill_tokens_skipped_pct": 100.0, "cold_ttft_p99_ms": 38.1,
+   "warm_ttft_p99_ms": 1.2, "ttft_ratio": 0.031, "byte_identical": true,
+   "new_compiles_on_hits": 0, "traces_total": 2, "warm_full_hits": 12,
+   "cross_tenant_shared_pages": 0, "pages_leaked": 0, "ok": true}
+
+Run:  python tools/prefix_cache_check.py [--requests N] [--tokens N]
+Exit status is 0 iff ``ok``; ``run_check()`` is importable (bench.py).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SYSTEM_LEN = 96          # shared system-prompt tokens (6 full 16-row pages)
+SUFFIX_LEN = 24          # unique per-request tail
+SKIP_FLOOR_PCT = 70.0
+TTFT_RATIO_MAX = 0.25
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(0.99 * (len(xs) - 1))))]
+
+
+def _run_wave(eng, prompts, n_tokens, seeds, tenant='default'):
+    """Sequential submit/stream; returns (streams, ttfts_ms)."""
+    streams, ttfts = [], []
+    for p, s in zip(prompts, seeds):
+        t0 = time.perf_counter()
+        fut = eng.submit(p, max_new_tokens=n_tokens, seed=s, tenant=tenant)
+        it = fut.stream(timeout=300)
+        first = next(it)
+        ttfts.append((time.perf_counter() - t0) * 1e3)
+        streams.append([first] + list(it))
+    return streams, ttfts
+
+
+def _child(n_requests, n_tokens):
+    import jax
+    import numpy as np
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving.generation import GenerationEngine
+
+    # big enough that cold prefill does real work on CPU (the TTFT ratio
+    # check is meaningless against a no-op model), small enough to compile
+    # in seconds
+    cfg = gpt.GPTConfig(vocab_size=101, hidden_size=192, num_layers=3,
+                        num_heads=4, max_seq_len=160, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, cfg.vocab_size, size=SYSTEM_LEN)
+    prompts = [np.concatenate([system,
+                               rng.integers(1, cfg.vocab_size,
+                                            size=SUFFIX_LEN)])
+               for _ in range(n_requests)]
+    seeds = list(range(n_requests))
+
+    def engine(**kw):
+        kw.setdefault('num_slots', 2)
+        kw.setdefault('page_size', 16)
+        kw.setdefault('prefill_width', 128)
+        kw.setdefault('num_pages', 96)
+        kw.setdefault('queue_capacity', 64)
+        return GenerationEngine(params, cfg, **kw)
+
+    out = {'requests': n_requests}
+
+    # ---- reference: cache OFF ------------------------------------------
+    ref = engine(prefix_cache=False)
+    ref.warmup()
+    want, _ = _run_wave(ref, prompts, n_tokens, seeds)
+    ref.shutdown()
+
+    # ---- cache ON: cold wave then warm wave ----------------------------
+    eng = engine(prefix_cache=True)
+    eng.warmup()                      # both executables AOT before timing
+
+    cold, cold_ttft = _run_wave(eng, prompts, n_tokens, seeds)
+    st_mid = eng.stats()
+    traces_mid = eng._trace_count
+
+    warm, warm_ttft = _run_wave(eng, prompts, n_tokens, seeds)
+    st_after = eng.stats()
+
+    out['cold_ttft_p99_ms'] = round(_p99(cold_ttft), 3)
+    out['warm_ttft_p99_ms'] = round(_p99(warm_ttft), 3)
+    out['ttft_ratio'] = round(_p99(warm_ttft) / max(_p99(cold_ttft), 1e-9),
+                              4)
+    warm_prompt_tokens = sum(len(p) for p in prompts)
+    saved_warm = (st_after['prefix_tokens_saved']
+                  - st_mid['prefix_tokens_saved'])
+    out['prefill_tokens_skipped_pct'] = round(
+        100.0 * saved_warm / warm_prompt_tokens, 2)
+    out['warm_full_hits'] = (st_after['prefix_full_hits']
+                             - st_mid['prefix_full_hits'])
+    out['byte_identical'] = bool(cold == want and warm == cold)
+    out['new_compiles_on_hits'] = eng._trace_count - traces_mid
+    # absolute: warmup traces both executables once; everything after —
+    # cold wave, warm wave, tenants — must reuse them
+    out['traces_total'] = eng._trace_count
+
+    # ---- cross-tenant isolation ----------------------------------------
+    shared_prompt = prompts[0]
+    a, _ = _run_wave(eng, [shared_prompt], n_tokens, [0], tenant='alpha')
+    b, _ = _run_wave(eng, [shared_prompt], n_tokens, [0], tenant='beta')
+    pages = eng.prefix_cache.debug_pages()
+    tenants = list(pages)
+    overlap = 0
+    for i, t1 in enumerate(tenants):
+        for t2 in tenants[i + 1:]:
+            overlap += len(set(pages[t1]) & set(pages[t2]))
+    out['cross_tenant_shared_pages'] = overlap
+    # identical prompt+seed under a new tenant must still sample the same
+    # stream (isolation is about pages, not outputs)
+    out['byte_identical'] = bool(out['byte_identical']
+                                 and a[0] == want[0] and b[0] == want[0])
+
+    # ---- drain + clear: every page back on the free list ---------------
+    eng.clear_prefix_cache()
+    free = eng._alloc.free_pages
+    out['pages_leaked'] = (eng.num_pages - 1) - free
+    eng.shutdown()
+    print(json.dumps(out))
+
+
+def run_check(n_requests=12, n_tokens=8, timeout=900):
+    """Run the check in a fresh subprocess; returns the summary dict with
+    the aggregate ``ok`` verdict (importable from bench.py and tests)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child',
+         '--requests', str(n_requests), '--tokens', str(n_tokens)],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f'prefix cache check child failed:\n'
+                           f'{proc.stdout}\n{proc.stderr}')
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out['ok'] = bool(
+        out['prefill_tokens_skipped_pct'] >= SKIP_FLOOR_PCT
+        and out['ttft_ratio'] <= TTFT_RATIO_MAX
+        and out['byte_identical']
+        and out['new_compiles_on_hits'] == 0
+        and out['traces_total'] == 2
+        and out['warm_full_hits'] == out['requests']
+        and out['cross_tenant_shared_pages'] == 0
+        and out['pages_leaked'] == 0)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--requests', type=int, default=12)
+    ap.add_argument('--tokens', type=int, default=8)
+    ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        _child(args.requests, args.tokens)
+        return 0
+    result = run_check(n_requests=args.requests, n_tokens=args.tokens)
+    print(json.dumps(result))
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
