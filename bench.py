@@ -693,6 +693,23 @@ def _child_prefix():
     print(json.dumps(prefix_cache_check.run_check()))
 
 
+def _child_devtime():
+    """Device-time + goodput gate row: tools/devtime_check.py in a fresh
+    subprocess — profile capture from live traffic whose attributed
+    categories (+ idle) sum to the capture window within +-5%, a finite
+    published measured MFU, overlap fraction in [0,1], zero span-ring
+    events added by attribution, artifact GC honoring the keep knob, an
+    injected checkpoint stall attributed >=80% to the checkpoint badput
+    cause with the per-run goodput ratio dropping, and the always-on
+    ledger under the <5% step budget. The parent banks devtime_*."""
+    _arm_watchdog(900)
+    _force_cpu_if_requested()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import devtime_check
+    print(json.dumps(devtime_check.run_check()))
+
+
 def _child_reqtrace_overhead():
     """Request-tracing overhead probe: aggregate decode tokens/s of a tiny
     GenerationEngine with the telemetry plane attached, run by the parent
@@ -1307,6 +1324,28 @@ def main(fast=False):
         else:
             print(f'prefix cache check failed: {pxnote}', file=sys.stderr)
 
+        # device-time attribution + goodput gate: category sums close
+        # over the capture window, measured MFU published, checkpoint
+        # stall lands on the checkpoint badput cause, ledger within
+        # budget (fresh process)
+        dv, dvnote = _run_child(['--child-devtime'], 900,
+                                env={'BENCH_CHILD_TIMEOUT': '900'})
+        if dv is not None:
+            out['devtime_ok'] = bool(dv.get('ok'))
+            out['devtime_sum_err_pct'] = dv.get('devtime_sum_err_pct')
+            out['devtime_mfu_measured'] = dv.get('mfu_measured')
+            out['devtime_overlap_fraction'] = dv.get('overlap_fraction')
+            out['devtime_unknown_events'] = dv.get('devtime_unknown_events')
+            out['devtime_profile_dirs_kept'] = dv.get('profile_dirs_kept')
+            out['devtime_ckpt_attribution_pct'] = dv.get(
+                'ckpt_attribution_pct')
+            out['devtime_goodput_ratio_clean'] = dv.get('ratio_clean')
+            out['devtime_goodput_ratio_stalled'] = dv.get('ratio_stalled')
+            out['devtime_goodput_overhead_pct'] = dv.get(
+                'goodput_overhead_pct')
+        else:
+            print(f'devtime check failed: {dvnote}', file=sys.stderr)
+
         # request-tracing overhead A/B on the decode rung: flight recorder
         # + telemetry server enabled vs hard-disabled; budget is <5%
         rt_res = {}
@@ -1444,6 +1483,8 @@ if __name__ == '__main__':
         _child_fleet_obs()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-prefix':
         _child_prefix()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-devtime':
+        _child_devtime()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':

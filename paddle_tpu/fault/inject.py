@@ -1,8 +1,11 @@
 """Env-controlled fault injection — the chaos hooks behind tools/chaos_check.
 
 Armed via ``PADDLE_FAULT_INJECT="point:prob[:action],..."`` where action is
-``raise`` (default: raise InjectedFault, exercising retry/degrade paths) or
-``kill`` (SIGKILL the process mid-operation, exercising crash recovery).
+``raise`` (default: raise InjectedFault, exercising retry/degrade paths),
+``kill`` (SIGKILL the process mid-operation, exercising crash recovery), or
+``delay:<secs>`` (sleep at the point then continue — a stall, not a
+failure: exercises timeout/goodput-attribution paths, e.g.
+``ckpt.write:1.0:delay:0.5`` injects a 500 ms checkpoint stall).
 ``PADDLE_FAULT_SEED`` makes firing decisions reproducible;
 ``PADDLE_FAULT_MAX`` caps how many faults fire per process.
 
@@ -31,6 +34,7 @@ on hot paths.
 import os
 import random
 import signal
+import time
 
 from .errors import InjectedFault
 
@@ -38,7 +42,7 @@ ENV_SPEC = 'PADDLE_FAULT_INJECT'
 ENV_SEED = 'PADDLE_FAULT_SEED'
 ENV_MAX = 'PADDLE_FAULT_MAX'
 
-_points = {}            # point -> (probability, action)
+_points = {}            # point -> (probability, action, delay_s)
 _rng = random.Random()
 _max_faults = None
 _fired = 0
@@ -56,10 +60,24 @@ def _parse(spec):
                 f'bad fault spec {part!r}: want point:prob[:action]')
         point, prob = fields[0], float(fields[1])
         action = fields[2] if len(fields) > 2 else 'raise'
-        if action not in ('raise', 'kill'):
+        delay = 0.0
+        if action == 'delay':
+            if len(fields) < 4:
+                raise ValueError(
+                    f'bad fault spec {part!r}: delay wants '
+                    f'point:prob:delay:<secs>')
+            delay = float(fields[3])
+        elif action not in ('raise', 'kill'):
             raise ValueError(f'bad fault action {action!r} in {part!r}')
-        out[point] = (prob, action)
+        out[point] = (prob, action, delay)
     return out
+
+
+def _norm_entry(ent):
+    """Accept legacy 2-tuples from programmatic configure(dict) callers."""
+    if len(ent) == 2:
+        return (ent[0], ent[1], 0.0)
+    return ent
 
 
 def configure(spec=None, seed=None, max_faults=None):
@@ -100,7 +118,7 @@ def inject(point):
     global _fired
     if _max_faults is not None and _fired >= _max_faults:
         return
-    prob, action = ent
+    prob, action, delay = _norm_entry(ent)
     if _rng.random() >= prob:
         return
     _fired += 1
@@ -109,6 +127,9 @@ def inject(point):
     _obs.record_event('fault.injected', point=point, action=action)
     if action == 'kill':
         os.kill(os.getpid(), signal.SIGKILL)
+    if action == 'delay':
+        time.sleep(delay)       # a stall, not a failure — then proceed
+        return
     raise InjectedFault(point)
 
 

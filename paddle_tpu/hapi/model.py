@@ -682,6 +682,11 @@ class Model:
         step_ms = _obs.histogram('train.step_ms')
         step_counter = _obs.counter('train.steps')
         loss_gauge = _obs.gauge('train.loss')
+        # always-on goodput accounting: the run window opens here; steps,
+        # data stalls, and compile steps are classified below, checkpoint/
+        # preemption/requeue badput arrives from the ckpt + retry paths
+        goodput = _obs.goodput.ledger()
+        goodput.run_start()
         for epoch in range(start_epoch, epochs):
             if auto_resume is not None:
                 # deterministic per-epoch shuffle so a resumed lifetime sees
@@ -697,6 +702,9 @@ class Model:
             prefetch_gen = (loader.prefetch_to_device() if use_prefetch
                             else None)
             batch_iter = prefetch_gen if prefetch_gen is not None else loader
+            # innermost wrapper: measures the raw loader/prefetch wait so
+            # blocking batch waits above the stall floor book as data_stall
+            batch_iter = goodput.data_iter(batch_iter)
             if timer is not None:
                 batch_iter = timer.timed_iter('data', batch_iter)
             try:
@@ -708,6 +716,7 @@ class Model:
                     do_update = (step_idx + 1) % accumulate_grad_batches == 0
                     if timer is not None:
                         t0 = time.perf_counter()
+                    traces_before = self._step_traces
                     try:
                         with _obs.span('train.step', step=it_count) as sp:
                             loss = self.train_batch(inputs, labels,
@@ -722,6 +731,11 @@ class Model:
                     step_ms.observe(1e3 * sp.duration)
                     step_counter.inc()
                     _obs.perf.note_step('hapi.train_step', sp.duration)
+                    if self._step_traces > traces_before:
+                        # the step retraced/compiled: the whole step wall
+                        # time is compile badput (goodput convention)
+                        goodput.note_badput('compile', sp.duration)
+                    goodput.note_step(sp.duration)
                     if timer is not None:
                         timer.add('dispatch', time.perf_counter() - t0)
                     lval = loss[0]
@@ -766,6 +780,7 @@ class Model:
             _obs.counter('train.epochs').inc()
             if self.stop_training:
                 break
+        goodput.run_end()
         fit_span.__exit__(None, None, None)
         # fit() exit is a read point: device-resident state flows back into
         # the Layer objects before user code (or on_train_end callbacks,

@@ -56,6 +56,8 @@ from .fleetobs import (FleetObs, MetricFederator,  # noqa: F401
                        ProfileBusyError, capture_profile, profile_in_flight,
                        register_gauge_semantics, stitch)
 from . import perf  # noqa: F401  (perf.analyze / note_step / sweep_hbm)
+from . import devtime  # noqa: F401  (devtime.attribute / classifier)
+from . import goodput  # noqa: F401  (goodput.ledger / snapshot)
 from . import promparse  # noqa: F401  (shared exposition parser)
 from . import slo   # noqa: F401  (slo.Watcher / slo.watcher())
 
@@ -73,7 +75,7 @@ __all__ = [
     'add_readiness', 'remove_readiness', 'readiness',
     'FleetObs', 'MetricFederator', 'ProfileBusyError', 'capture_profile',
     'profile_in_flight', 'register_gauge_semantics', 'stitch',
-    'perf', 'promparse', 'slo',
+    'perf', 'devtime', 'goodput', 'promparse', 'slo',
 ]
 
 
@@ -86,6 +88,7 @@ def reset():
     reset_trace()
     reset_requests()
     perf.reset_perf()
+    goodput.reset_goodput()
 
 
 def dump(directory):

@@ -68,8 +68,10 @@ from .registry import (_prom_help, _prom_labels, _prom_name, cfg, counter,
 
 ENV_PROFILE_CAP = 'PADDLE_TPU_OBS_PROFILE_CAP_MS'
 ENV_PROFILE_DIR = 'PADDLE_TPU_OBS_PROFILE_DIR'
+ENV_PROFILE_KEEP = 'PADDLE_TPU_OBS_PROFILE_KEEP'
 
 MAX_PROFILE_WINDOW_MS = float(os.environ.get(ENV_PROFILE_CAP, 10_000.0))
+PROFILE_DIR_PREFIX = 'pt_profile_'
 
 _QUANTS = ((50, 'p50', '0.5'), (90, 'p90', '0.9'), (99, 'p99', '0.99'))
 
@@ -85,10 +87,15 @@ _GAUGE_SEMANTICS = {
     'host_hbm_watermark_bytes': 'min',
     # ratios average; summing MFU across replicas would exceed 1.0
     'perf_mfu': 'mean',
+    'perf_mfu_measured': 'mean',
     'gen_occupancy': 'mean',
     'gen_page_utilization': 'mean',
+    'devtime_overlap_fraction': 'mean',
+    'devtime_idle_pct': 'mean',
+    'goodput_ratio': 'mean',
     # liveness-style gauges: the worst replica is the story
     'fleet_obs_staleness_s': 'max',
+    'devtime_straggler_skew_ms': 'max',
 }
 _VALID_SEMANTICS = ('sum', 'min', 'max', 'mean', 'last')
 
@@ -618,6 +625,53 @@ class ProfileBusyError(RuntimeError):
 _profile_lock = threading.Lock()
 
 
+def profile_keep():
+    """How many capture artifact dirs to retain (LRU by mtime)."""
+    try:
+        return max(1, int(os.environ.get(ENV_PROFILE_KEEP, '8')))
+    except ValueError:
+        return 8
+
+
+def _gc_profile_dirs(current_dir):
+    """Retention for on-demand captures: keep the newest ``profile_keep()``
+    ``pt_profile_*`` siblings of ``current_dir`` (by mtime, the running
+    capture always kept), delete the rest so repeated ``/debug/profile``
+    hits cannot fill the disk. Returns the number removed (also counted
+    on ``fleet.obs.profile_gc_total``)."""
+    import shutil
+    root = os.path.dirname(os.path.abspath(current_dir))
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    dirs = []
+    for n in names:
+        if not n.startswith(PROFILE_DIR_PREFIX):
+            continue
+        p = os.path.join(root, n)
+        if not os.path.isdir(p):
+            continue
+        try:
+            mt = os.path.getmtime(p)
+        except OSError:
+            continue
+        dirs.append((mt, p))
+    keep = profile_keep()
+    dirs.sort(reverse=True)                      # newest first
+    cur = os.path.abspath(current_dir)
+    victims = [p for _, p in dirs[keep:] if os.path.abspath(p) != cur]
+    removed = 0
+    for p in victims:
+        shutil.rmtree(p, ignore_errors=True)
+        removed += 1
+    if removed:
+        counter('fleet.obs.profile_gc_total',
+                help='profile artifact dirs removed by retention').inc(
+                    removed)
+    return removed
+
+
 def capture_profile(ms=500.0, out_dir=None):
     """Capture a bounded ``jax.profiler`` device trace from the running
     process and return a summary dict.
@@ -666,11 +720,24 @@ def capture_profile(ms=500.0, out_dir=None):
                    'artifact_dir': os.path.abspath(out_dir),
                    'files': sorted(files, key=lambda f: f['path']),
                    'bytes': total, 'ts': time.time()}
+        # host-side attribution of the capture we just wrote: per-category
+        # device time, overlap fraction, measured MFU — published to the
+        # registry AND embedded so /debug/profile returns analysis inline
+        try:
+            from . import devtime
+            summary['devtime'] = devtime.attribute(out_dir, window_ms=ms)
+        except Exception as e:
+            summary['devtime'] = {
+                'error': f'{type(e).__name__}: {e}'[:300]}
+            counter('fleet.obs.profile_analyze_errors',
+                    help='devtime attribution failures on captured '
+                         'profiles').inc()
         try:
             with open(os.path.join(out_dir, 'summary.json'), 'w') as f:
                 json.dump(summary, f, indent=1, sort_keys=True)
         except OSError:
             pass
+        _gc_profile_dirs(out_dir)
         counter('fleet.obs.profiles',
                 help='on-demand device profile captures').inc()
         return summary

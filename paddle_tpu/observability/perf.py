@@ -207,7 +207,18 @@ def _cost_convention():
     return _convention
 
 
-def analyze_compiled(label, compiled, precision=None):
+def _module_name(compiled):
+    """The compiled HLO module name (``jit_<fn>``) — the event name this
+    executable shows up under on device lanes in a profiler trace, which
+    is how ``devtime.attribute`` counts its executions. None on failure."""
+    try:
+        mods = compiled.runtime_executable().hlo_modules()
+        return mods[0].name if mods else None
+    except Exception:
+        return None
+
+
+def analyze_compiled(label, compiled, precision=None, pyname=None):
     """Publish one compiled executable's static costs under ``fn=label``.
     All figures are PER CHIP (see module docstring) so the roofline/MFU
     join against the per-chip peak table stays honest under a mesh.
@@ -252,7 +263,8 @@ def analyze_compiled(label, compiled, precision=None):
     rec = {'fn': label, 'flops': flops, 'bytes_accessed': nbytes,
            'n_devices': n_dev, 'intensity': round(intensity, 4),
            'bound_by': bound_by, 'hbm': mem, 'mfu': None,
-           'step_ms_p50': None, 'precision': prec}
+           'step_ms_p50': None, 'precision': prec,
+           'module': _module_name(compiled), 'pyname': pyname}
     with _lock:
         _records[label] = rec
         _mfu_handles.pop(label, None)
@@ -275,7 +287,9 @@ def analyze(label, jitted, args=(), kwargs=None, precision=None):
     except Exception:
         _registry().counter('perf.analyze_errors', {'fn': label}).inc()
         return None
-    return analyze_compiled(label, compiled, precision=precision)
+    pyname = getattr(jitted, '__name__', None)
+    return analyze_compiled(label, compiled, precision=precision,
+                            pyname=pyname)
 
 
 def analyzed(label):
@@ -283,6 +297,13 @@ def analyzed(label):
     wiring sites use to analyze each executable exactly once."""
     with _lock:
         return _records.get(label)
+
+
+def records():
+    """Copies of every stored roofline record, keyed by label — the join
+    source for ``devtime.attribute``'s measured-MFU computation."""
+    with _lock:
+        return {k: dict(v) for k, v in _records.items()}
 
 
 def note_step(label, seconds, precision=None):

@@ -341,8 +341,44 @@ class MetricsRegistry:
                      self._help.get(name, name))
                     for name, (t, children) in sorted(self._families.items())]
 
+    # the registry's own health gauges never count toward cardinality, so
+    # back-to-back exports report the same figure (byte-identical renders)
+    _SELF_FAMILIES = ('obs.series_total', 'obs.trace_dropped_total')
+
+    def series_total(self):
+        """Number of registered series (children across all families,
+        excluding the registry's own health gauges) — the
+        label-cardinality figure behind ``obs.series_total``."""
+        with self._lock:
+            return sum(len(children)
+                       for name, (_, children) in self._families.items()
+                       if name not in self._SELF_FAMILIES)
+
+    def refresh_self_metrics(self):
+        """Refresh the registry's own health gauges: ``obs.series_total``
+        (cardinality-explosion detector) and ``obs.trace_dropped_total``
+        (span-ring overflow). Called on every export (snapshot /
+        exposition) so SLO rules and scrapers always see current values;
+        safe to call directly. The trace import is deferred — trace.py
+        imports this module at load time."""
+        if not cfg.enabled:
+            return
+        n = self.series_total()
+        if n == 0:
+            # an empty registry must stay empty through an export — don't
+            # let observing the registry materialize its first series
+            return
+        from .trace import trace_dropped
+        self.gauge('obs.series_total',
+                   help='registered metric series (children across all '
+                        'families)').set(n)
+        self.gauge('obs.trace_dropped_total',
+                   help='span-ring events evicted by the bounded trace '
+                        'buffer').set(trace_dropped())
+
     def snapshot(self):
         """JSON-serializable view of every registered series."""
+        self.refresh_self_metrics()
         out = {'ts': time.time(),
                'counters': {}, 'gauges': {}, 'histograms': {}}
         for name, t, children, _ in self._items():
@@ -355,6 +391,7 @@ class MetricsRegistry:
         """Prometheus text exposition format (histograms as summaries),
         with ``# HELP`` alongside every ``# TYPE`` so the exposition
         survives strict scrapers when federated."""
+        self.refresh_self_metrics()
         lines = []
         for name, t, children, help_text in self._items():
             pname = _prom_name(name)

@@ -31,7 +31,12 @@ Endpoints:
   device capture (``fleetobs.capture_profile``): one capture at a time
   (a concurrent request gets **409**), window clamped to
   ``fleetobs.MAX_PROFILE_WINDOW_MS``, summary JSON (artifact dir, file
-  list, byte count) returned; 503 when observability is disabled.
+  list, byte count, and the inline ``devtime`` attribution — per-category
+  device time, overlap fraction, measured MFU) returned; 503 when
+  observability is disabled.
+- ``GET /debug/goodput`` — the always-on training goodput ledger
+  (``goodput.snapshot()``): elapsed/goodput seconds, ratio, and badput
+  seconds per cause (compile/checkpoint/data_stall/preemption/requeue).
 
 A server with a ``FleetObs`` attached (``serve_telemetry(fleetobs=...)``
 or ``FleetObs.serve()``) federates: ``/metrics`` returns the AGGREGATED
@@ -230,6 +235,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, summary)
 
+    def _debug_goodput(self, q):
+        from . import goodput as _goodput
+        self._send_json(200, _goodput.snapshot())
+
 
 _ROUTES = {
     '/metrics': _Handler._metrics,
@@ -240,6 +249,7 @@ _ROUTES = {
     '/debug/slo': _Handler._debug_slo,
     '/debug/fleet': _Handler._debug_fleet,
     '/debug/profile': _Handler._debug_profile,
+    '/debug/goodput': _Handler._debug_goodput,
 }
 
 

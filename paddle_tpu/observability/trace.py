@@ -27,6 +27,7 @@ TRACE_CAP = int(os.environ.get('PADDLE_TPU_OBS_TRACE_CAP', '100000'))
 
 _lock = threading.Lock()
 _events = collections.deque(maxlen=TRACE_CAP)
+_dropped = 0            # events evicted by a full ring (or a cap shrink)
 
 
 def set_trace_cap(n):
@@ -34,16 +35,34 @@ def set_trace_cap(n):
     endpoint). The env knob only sets the import-time default; this swaps
     the ring for one of the new capacity, keeping the newest events.
     Returns the new cap."""
-    global TRACE_CAP, _events
+    global TRACE_CAP, _events, _dropped
     n = max(1, int(n))
     with _lock:
         TRACE_CAP = n
+        _dropped += max(0, len(_events) - n)
         _events = collections.deque(_events, maxlen=n)
     return n
 
 
 def trace_cap():
     return TRACE_CAP
+
+
+def trace_dropped():
+    """Lifetime count of events the bounded ring has evicted — surfaced
+    as the ``obs.trace_dropped_total`` registry gauge so ring overflow is
+    itself observable (and SLO-rule-able)."""
+    with _lock:
+        return _dropped
+
+
+def _append_locked(rec):
+    # caller holds _lock; eviction by a full deque is the silent-drop
+    # path the self-metrics satellite makes visible
+    global _dropped
+    if len(_events) == _events.maxlen:
+        _dropped += 1
+    _events.append(rec)
 _tid_names = {}          # tid -> thread name at record time (for ph:'M')
 _origin_mono = time.perf_counter()
 _origin_wall = time.time()
@@ -120,7 +139,7 @@ class Span:
         if args:
             rec['args'] = args
         with _lock:
-            _events.append(rec)
+            _append_locked(rec)
             _tid_names[tid] = threading.current_thread().name
         return False
 
@@ -164,7 +183,7 @@ def record_event(name, **attrs):
     if attrs:
         rec['args'] = attrs
     with _lock:
-        _events.append(rec)
+        _append_locked(rec)
         _tid_names[tid] = threading.current_thread().name
 
 
@@ -186,9 +205,11 @@ def trace_events(since_us=None):
 
 
 def reset_trace():
+    global _dropped
     with _lock:
         _events.clear()
         _tid_names.clear()
+        _dropped = 0
 
 
 def _wall_anchor():

@@ -2,6 +2,8 @@
 reference's randomized per-op unittests at a higher altitude)."""
 import numpy as np
 import pytest
+
+pytest.importorskip('hypothesis')
 from hypothesis import given, settings, strategies as st
 
 import paddle_tpu as paddle

@@ -29,8 +29,8 @@ import os
 import sys
 
 NAMESPACES = ('train', 'serve', 'gen.prefix', 'gen', 'fault', 'ckpt',
-              'data', 'warmup', 'perf', 'slo', 'request', 'server', 'fleet',
-              'host')
+              'data', 'warmup', 'perf', 'devtime', 'goodput', 'slo',
+              'request', 'server', 'fleet', 'host', 'obs')
 
 
 def _load(path):

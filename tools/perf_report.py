@@ -42,7 +42,8 @@ def collect(snap):
     def row(fn):
         return rows.setdefault(fn, {'fn': fn, 'flops': None, 'bytes': None,
                                     'intensity': None, 'bound_by': None,
-                                    'mfu': None, 'achieved_flops': None,
+                                    'mfu': None, 'mfu_measured': None,
+                                    'achieved_flops': None,
                                     'hbm': {}, 'step_ms_p50': None})
 
     for key, val in gauges.items():
@@ -64,6 +65,9 @@ def collect(snap):
             r['bound_by'] = 'compute' if val else 'memory'
         elif metric == 'mfu':
             r['mfu'] = val
+        elif metric == 'mfu_measured':
+            # profiler-measured device time (devtime), not the cost model
+            r['mfu_measured'] = val
         elif metric == 'achieved_flops':
             r['achieved_flops'] = val
         elif metric == 'hbm_bytes' and 'kind' in lbl:
@@ -77,6 +81,17 @@ def collect(snap):
     peaks = {'peak_flops': gauges.get('perf.peak_flops'),
              'peak_bw': gauges.get('perf.peak_bw'),
              'ridge': gauges.get('perf.ridge')}
+    devtime = None
+    if 'devtime.window_ms' in gauges:
+        devtime = {'window_ms': gauges['devtime.window_ms'],
+                   'idle_pct': gauges.get('devtime.idle_pct'),
+                   'overlap_fraction': gauges.get('devtime.overlap_fraction'),
+                   'straggler_skew_ms': gauges.get(
+                       'devtime.straggler_skew_ms'),
+                   'categories_ms': {
+                       k.split('category=', 1)[1].rstrip('}'): v
+                       for k, v in gauges.items()
+                       if k.startswith('devtime.category_ms{')}}
     execs = sorted(rows.values(), key=lambda r: -(r['flops'] or 0))
     for r in execs:
         pf = peaks['peak_flops']
@@ -85,7 +100,8 @@ def collect(snap):
     hbm_dev = {k.split('device=', 1)[1].rstrip('}'): v
                for k, v in gauges.items()
                if k.startswith('perf.hbm_used_bytes{')}
-    return {'peaks': peaks, 'executables': execs, 'hbm_used': hbm_dev}
+    return {'peaks': peaks, 'executables': execs, 'hbm_used': hbm_dev,
+            'devtime': devtime}
 
 
 def _eng(v, unit=''):
@@ -104,9 +120,18 @@ def render_text(report):
                  f'bw: {_eng(p["peak_bw"], "B/s")}  '
                  f'ridge: {p["ridge"]} FLOP/B')
     lines.append('')
+    dv = report.get('devtime')
+    if dv:
+        cats = '  '.join(f'{k}={v:.1f}ms'
+                         for k, v in sorted(dv['categories_ms'].items()))
+        lines.append(f'last capture ({dv["window_ms"]}ms): {cats}')
+        lines.append(f'  idle: {dv["idle_pct"]}%  overlap: '
+                     f'{dv["overlap_fraction"]}  straggler skew: '
+                     f'{dv["straggler_skew_ms"]}ms')
+        lines.append('')
     lines.append(f'{"executable":<26} {"flops":>9} {"bytes":>9} '
                  f'{"intens":>7} {"bound-by":>8} {"mfu":>7} '
-                 f'{"ach/peak":>8} {"p50 ms":>8}')
+                 f'{"meas":>7} {"ach/peak":>8} {"p50 ms":>8}')
     def _ratio(v):
         if v is None:
             return '-'
@@ -114,12 +139,14 @@ def render_text(report):
 
     for r in report['executables']:
         mfu = _ratio(r['mfu'])
+        meas = _ratio(r.get('mfu_measured'))
         frac = _ratio(r['frac_of_peak'])
         p50 = f'{r["step_ms_p50"]:.2f}' if r['step_ms_p50'] else '-'
         lines.append(f'{r["fn"]:<26} {_eng(r["flops"]):>9} '
                      f'{_eng(r["bytes"]):>9} '
                      f'{r["intensity"] if r["intensity"] is not None else "-":>7} '
-                     f'{r["bound_by"] or "-":>8} {mfu:>7} {frac:>8} {p50:>8}')
+                     f'{r["bound_by"] or "-":>8} {mfu:>7} {meas:>7} '
+                     f'{frac:>8} {p50:>8}')
         if r['hbm']:
             hbm = '  '.join(f'{k}={_eng(r["hbm"].get(k), "B")}'
                             for k in _MEM_KINDS if k in r['hbm'])

@@ -11,6 +11,12 @@ TPU_SESSION_NOTES.md: block_until_ready is a no-op on the axon platform):
   flash       flash attention fwd / fwd+bwd at model shapes, x layers
   gemm        sustained bf16 GEMM ceiling (sanity: how close is the chip
               to its datasheet peak — see _detect_peak — on a pure matmul)
+  devtime     measured per-category device time for the full step: a
+              bounded jax.profiler capture around live steps, attributed
+              through the SHARED observability/devtime.py classifier
+              (one event-classification table, not a drifting local copy)
+              — emits devtime_{matmul,compute,collective,copy,infeed,
+              idle}_ms, devtime_overlap_fraction, devtime_mfu_measured
 
 Run in a bounded subprocess:  timeout 900 python tools/tpu_breakdown.py
 """
@@ -107,6 +113,33 @@ def main():
     emit('full_ms', dt * 1e3)
     emit('tokens_per_sec', BATCH * SEQ / dt)
     emit('mfu', 6.0 * n_params * res['tokens_per_sec'] / peak)
+
+    # measured device-time attribution for the full step: profile a few
+    # live steps, classify every event through the shared devtime table
+    try:
+        import shutil
+        import tempfile
+        from paddle_tpu.observability import devtime, perf
+        perf.analyze('breakdown.full_step', jstep,
+                     (params, opt_state, key, lr, toks, toks))
+        prof_dir = tempfile.mkdtemp(prefix='pt_breakdown_prof_')
+        t0 = time.perf_counter()
+        with jax.profiler.trace(prof_dir):
+            for _ in range(3):
+                fence(jstep(params, opt_state, key, lr, toks, toks))
+        prof_ms = 1e3 * (time.perf_counter() - t0)
+        att = devtime.attribute(prof_dir, window_ms=prof_ms, publish=False)
+        shutil.rmtree(prof_dir, ignore_errors=True)
+        for cat, v in att['categories_ms'].items():
+            emit(f'devtime_{cat}_ms', v)
+        emit('devtime_overlap_fraction', att['overlap']['fraction'])
+        emit('devtime_unknown_events', att['unknown_events'])
+        emit('devtime_classifier_version', att['classifier_version'])
+        mfu_m = (att.get('mfu_measured') or {}).get('total')
+        if mfu_m is not None:
+            emit('devtime_mfu_measured', mfu_m)
+    except Exception as e:                   # noqa: BLE001 — partial data
+        emit('devtime_error', f'{type(e).__name__}: {e}'[:300])
 
     # grad only
     jgrad = jax.jit(lambda p, t, y: jax.value_and_grad(gpt.loss_fn)(p, t, y, CFG))
