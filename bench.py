@@ -804,6 +804,78 @@ def _child_dp2():
     }))
 
 
+def _child_mp2():
+    """2-device mesh-serving rung (always a CPU-mesh child, like
+    --child-dp2): the SAME ragged request stream decode_bench drives,
+    served by ONE mesh-sharded GenerationEngine spanning an mp=2 device
+    mesh (params by the partitioner table, paged-KV pool sharded on its
+    heads axis). Banks aggregate tok/s, TTFT p99 and the trace count —
+    which must be EXACTLY 2, the uniformity claim: mesh size never costs
+    a retrace. Streams are checked byte-identical against an mp=1 engine
+    at matched seeds."""
+    _arm_watchdog(300)
+    import numpy as np
+    import jax
+    _force_cpu_if_requested()
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (GenerationEngine,
+                                    sharded_generation_engine)
+
+    mp = min(2, len(jax.devices()))
+    cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=2, max_seq_len=256, dtype='float32',
+                        remat=False, use_flash=False)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    requests, max_new = 8, 32
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=rng.randint(4, 48)).tolist()
+               for _ in range(requests)]
+
+    def serve(mp_deg):
+        kw = dict(num_slots=8, page_size=32, prefill_width=64,
+                  queue_capacity=64)
+        eng = (sharded_generation_engine(params, cfg, mp=mp_deg, **kw)
+               if mp_deg > 1 else GenerationEngine(params, cfg, **kw))
+        try:
+            eng.warmup()
+            t0 = time.perf_counter()
+            subs, futs = [], []
+            for i, p in enumerate(prompts):
+                subs.append(time.perf_counter())
+                futs.append(eng.submit(p, max_new_tokens=max_new, seed=i))
+            streams, ttfts, total = [], [], 0
+            for t_sub, f in zip(subs, futs):
+                toks = []
+                for tok in f.stream(timeout=300):
+                    if not toks:
+                        ttfts.append((time.perf_counter() - t_sub) * 1e3)
+                    toks.append(tok)
+                streams.append(toks)
+                total += len(toks)
+            span = time.perf_counter() - t0
+            return {'streams': streams,
+                    'tokens_per_sec': total / span if span > 0 else 0.0,
+                    'ttft_p99_ms': sorted(ttfts)[
+                        min(len(ttfts) - 1, int(len(ttfts) * 0.99))],
+                    'traces': int(eng.stats()['traces'])}
+        finally:
+            eng.shutdown()
+
+    ref = serve(1)
+    got = serve(mp)
+    print(json.dumps({
+        'mp2_tokens_per_sec': round(got['tokens_per_sec'], 1),
+        'mp2_per_chip_tokens_per_sec': round(
+            got['tokens_per_sec'] / mp, 1),
+        'mp2_ttft_p99_ms': round(got['ttft_p99_ms'], 1),
+        'mp2_traces': got['traces'],
+        'mp1_tokens_per_sec': round(ref['tokens_per_sec'], 1),
+        'mp2_tokens_match': got['streams'] == ref['streams'],
+        'n_devices': mp,
+    }))
+
+
 def _child_smoke():
     """30s pallas compile-smoke: compile+run the flash fwd AND bwd kernels on
     a tiny shape with a host-read fence. Run by the tunnel watcher on relay
@@ -920,7 +992,10 @@ def main(fast=False):
         repo = os.path.dirname(os.path.abspath(__file__))
         lr = subprocess.run(
             [sys.executable, os.path.join(repo, 'tools', 'lint.py'),
-             os.path.join(repo, 'paddle_tpu'), '--json'],
+             os.path.join(repo, 'paddle_tpu'),
+             os.path.join(repo, 'tools', 'mesh_drill.py'),
+             os.path.join(repo, 'tools', 'shard_check.py'),
+             os.path.join(repo, 'tools', 'fleet_drill.py'), '--json'],
             capture_output=True, text=True, timeout=120)
         lint = json.loads(lr.stdout)
         out['lint_findings'] = int(lint.get('total', -1))
@@ -1194,6 +1269,23 @@ def main(fast=False):
             out['decode_cb_tokens_match'] = cb['tokens_match']
         else:
             print(f'continuous-batching decode bench failed: {cbnote}',
+                  file=sys.stderr)
+
+        # mesh-serving rung: the decode stream again, through ONE
+        # mp=2-sharded engine (always a CPU-mesh child, like --child-dp2)
+        mp2_env = {'BENCH_FORCE_CPU': '1', 'JAX_PLATFORMS': 'cpu',
+                   'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+                   'BENCH_CHILD_TIMEOUT': '300'}
+        m2, m2note = _run_child(['--child-mp2'], 300, env=mp2_env)
+        if m2 is not None:
+            out['mp2_tokens_per_sec'] = m2['mp2_tokens_per_sec']
+            out['mp2_per_chip_tokens_per_sec'] = \
+                m2['mp2_per_chip_tokens_per_sec']
+            out['mp2_ttft_p99_ms'] = m2['mp2_ttft_p99_ms']
+            out['mp2_traces'] = m2['mp2_traces']
+            out['mp2_tokens_match'] = m2['mp2_tokens_match']
+        else:
+            print(f'mp2 mesh-serving rung failed: {m2note}',
                   file=sys.stderr)
 
         f8, f8note = _run_child(['--child-fp8-train'], CONFIG_TIMEOUT_S)
@@ -1487,6 +1579,8 @@ if __name__ == '__main__':
         _child_devtime()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-reqtrace-overhead':
         _child_reqtrace_overhead()
+    elif len(sys.argv) > 1 and sys.argv[1] == '--child-mp2':
+        _child_mp2()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-dp2':
         _child_dp2()
     elif len(sys.argv) > 1 and sys.argv[1] == '--child-smoke':

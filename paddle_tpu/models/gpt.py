@@ -628,17 +628,37 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
 
 
 def paged_forward_with_cache(params, tokens, cache, pos, config,
-                             last_only=False, block=_cached_block):
+                             last_only=False, block=_cached_block,
+                             partitioner=None):
     """Paged-cache twin of ``forward_with_cache``: ``cache`` carries the
     page pools + ``page_table`` (+ optional ``valid``), ``pos`` is a [B]
     i32 vector. ``block`` lets moe_gpt reuse this driver with its own
     block body. Returns (logits, cache) with the table/valid passed
-    through so the caller's cache pytree keeps one structure."""
+    through so the caller's cache pytree keeps one structure.
+
+    ``partitioner`` (a mesh-bound parallel.Partitioner) makes the trace
+    mesh-aware: the KV pool planes are constrained to the ``kv_heads``
+    layout on entry AND exit, so GSPMD keeps pages head-sharded across the
+    whole layer scan instead of resharding KV around the attention
+    collectives (parallel/mesh_engine.py; a None partitioner — the mp=1
+    path — traces byte-identically to before)."""
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     pos_v = jnp.asarray(pos, jnp.int32).reshape(-1)
     page_table = cache['page_table']
     valid = cache.get('valid')
+
+    def pin_pool(plane):
+        # int8 pools are {'int8','scale'} banks whose scale plane drops
+        # the head_dim axis — only the raw 5-d layout is pinned (banks
+        # still shard correctly via input-sharding propagation)
+        if partitioner is None or getattr(plane, 'ndim', 0) != 5:
+            return plane
+        from ..ops.paged_kv import POOL_LOGICAL_AXES
+        return jax.lax.with_sharding_constraint(
+            plane, partitioner.sharding(POOL_LOGICAL_AXES))
+
+    cache = dict(cache, k=pin_pool(cache['k']), v=pin_pool(cache['v']))
     # STATIC marker set by the prefix-cache tail-prefill path (the engine
     # builds the cache dict in-trace, so a plain bool survives): q rows
     # must attend KV resident in earlier pages, not just the fresh rows
@@ -657,6 +677,7 @@ def paged_forward_with_cache(params, tokens, cache, pos, config,
 
     x, (k_new, v_new) = jax.lax.scan(
         scan_body, x, (params['blocks'], cache['k'], cache['v']))
+    k_new, v_new = pin_pool(k_new), pin_pool(v_new)
     if last_only:
         if valid is not None:
             # per-slot prompt lengths: pick each slot's last REAL row
@@ -673,7 +694,7 @@ def paged_forward_with_cache(params, tokens, cache, pos, config,
 
 
 def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
-                       last_only=False):
+                       last_only=False, partitioner=None):
     """Run [B, T] tokens whose absolute positions start at ``pos`` (a traced
     scalar), reading/writing the KV cache. Returns (logits, cache) — logits
     [B,T,V], or [B,1,V] with ``last_only`` (prefill skips the full-vocab
@@ -684,10 +705,13 @@ def forward_with_cache(params, tokens, cache, pos, config: GPTConfig,
 
     A paged cache (``is_paged``: page pools + ``page_table``) routes to
     ``paged_forward_with_cache`` with ``pos`` as a per-slot [B] vector;
-    the dense contiguous cache stays the default."""
+    the dense contiguous cache stays the default. ``partitioner`` (mesh-
+    bound, serving over an mp=N mesh) pins the paged pool to the
+    ``kv_heads`` layout — see paged_forward_with_cache."""
     if is_paged(cache):
         return paged_forward_with_cache(params, tokens, cache, pos, config,
-                                        last_only=last_only)
+                                        last_only=last_only,
+                                        partitioner=partitioner)
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)

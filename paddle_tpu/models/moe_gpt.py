@@ -293,19 +293,22 @@ def _cached_block(bp, x, k_cache, v_cache, pos, config, page_table=None,
     return x + ff, k_cache, v_cache
 
 
-def forward_with_cache(params, tokens, cache, pos, config, last_only=False):
+def forward_with_cache(params, tokens, cache, pos, config, last_only=False,
+                       partitioner=None):
     """[B, T] tokens at absolute positions starting at ``pos`` (traced
     scalar) -> (logits, cache). See gpt.forward_with_cache. A paged cache
     (gpt.is_paged) routes through gpt.paged_forward_with_cache with THIS
     module's block body (MoE FFN per token; note the capacity caveat in
     the section comment above — decode slots in one batch compete for
     expert capacity, so exact dense parity needs generous
-    capacity_factor)."""
+    capacity_factor). ``partitioner`` (mesh-bound, serving over an mp=N
+    mesh) pins the paged pool to the ``kv_heads`` layout."""
     from .gpt import is_paged, paged_forward_with_cache
     if is_paged(cache):
         return paged_forward_with_cache(params, tokens, cache, pos, config,
                                         last_only=last_only,
-                                        block=_cached_block)
+                                        block=_cached_block,
+                                        partitioner=partitioner)
     cdt = jnp.dtype(config.dtype)
     B, T = tokens.shape
     ppos = pos + jnp.arange(T)

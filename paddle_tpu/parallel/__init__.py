@@ -9,3 +9,6 @@ from . import zero  # noqa: F401
 from .zero import make_zero_train_step  # noqa: F401
 from .partitioner import (Partitioner, ShardingRuleError,  # noqa: F401
                           DEFAULT_RULES, model_rules)
+from . import mesh_engine  # noqa: F401
+from .mesh_engine import (MeshContext, build_mesh,  # noqa: F401
+                          serving_rules)

@@ -52,6 +52,14 @@ DEFAULT_RULES = (
     ('heads', 'mp'),
     ('mlp', 'mp'),
     ('kv', None),
+    # paged-KV pool (ops/paged_kv.py): KV heads shard over mp (GQA packing
+    # keeps each rank's query groups beside its kv heads); pages are
+    # replicated BY RULE — the pool's +1 reserved trash page makes the
+    # page count indivisible by any mp > 1, so one logical page always
+    # maps to N physical head-shards and the host-side allocator stays
+    # mesh-agnostic
+    ('kv_heads', 'mp'),
+    ('kv_pages', None),
     ('expert', 'ep'),
     ('layers', 'pp'),
     ('embed', None),
@@ -269,4 +277,8 @@ def model_rules(mp=1, pp=1, sp=1, ep=1, explicit=False):
         ('expert', 'ep'),
         ('layers', 'pp' if pp > 1 else None),
         ('embed', None),
+        # serving-path paged KV (see DEFAULT_RULES): heads shard with the
+        # attention heads; the page dim stays whole (trash page included)
+        ('kv_heads', mp_ax),
+        ('kv_pages', None),
     )

@@ -37,6 +37,14 @@ finished sequences' paged-KV pages resident in a tenant-namespaced
 ``PrefixCache`` — a repeat prefix is admitted with its pages pre-mapped
 (refcounted sharing + copy-on-write), prefilling only the uncached tail
 and skipping prefill entirely on an exact ``(prompt, seed)`` repeat.
+
+Sharded serving (``sharded.py``): one replica spanning an ``mp=N``
+device mesh — ``sharded_generation_engine``/``MeshReplica`` place params
+and the paged KV pool (heads axis) through the logical-axis rules table
+and run the same two compiled programs as one SPMD executable. Streams
+are byte-identical across mesh shapes at matched seeds, warm spawn and
+host swap-in stay zero-retrace, and ``ModelHost.deploy(..., mp=N)``
+admits by per-chip footprint.
 """
 from .bucketing import (bucket_for, bucket_sizes, input_signature,  # noqa: F401
                         pad_rows)
@@ -51,12 +59,15 @@ from .fleet import (Autoscaler, FleetRouter, Replica,  # noqa: F401
                     ReplicaSet)
 from .host import (HostedModel, ModelHost, get_host,  # noqa: F401
                    resolve_target)
+from .sharded import (MeshReplica, sharded_generation_engine,  # noqa: F401
+                      sharded_inference_engine)
 
 __all__ = [
     'InferenceEngine', 'ServingStats', 'BucketCompileCache',
     'GenerationEngine', 'GenerationFuture', 'PrefixCache',
     'ReplicaSet', 'FleetRouter', 'Autoscaler', 'Replica',
     'ModelHost', 'HostedModel', 'get_host', 'resolve_target',
+    'MeshReplica', 'sharded_generation_engine', 'sharded_inference_engine',
     'bucket_for', 'bucket_sizes', 'pad_rows', 'input_signature',
     'QueueFullError', 'DeadlineExceededError', 'EngineClosedError',
     'HBMAdmissionError',

@@ -141,7 +141,7 @@ class InferenceEngine:
     def __init__(self, net=None, *, max_batch_size=None, max_delay_ms=None,
                  queue_capacity=256, precision=None, default_deadline_ms=None,
                  breaker=None, autostart=True, clock=None, warmup=None,
-                 input_spec=None, telemetry_port=None):
+                 input_spec=None, telemetry_port=None, mesh=None, mp=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -178,6 +178,33 @@ class InferenceEngine:
                     for k, v in tree.items()}
         self._params = lower(params)
         self._buffers = lower(buffers)
+        # mesh-sharded replica (mp=N): bucket executables become ONE SPMD
+        # program over N chips. Params place by each Parameter's
+        # ``logical_axes`` annotation through the mesh partitioner
+        # (un-annotated / indivisible params replicate — memory, never
+        # correctness); request arrays stay replicated host inputs.
+        from ..parallel import mesh_engine as _mesh
+        self._mesh_ctx = _mesh.resolve(mesh, mp=mp)
+        if self._mesh_ctx is not None:
+            ctx = self._mesh_ctx
+            annot = {}
+            for n, p in layer.named_parameters():
+                la = getattr(p, 'logical_axes', None)
+                if la is not None:
+                    annot[n] = tuple(la)
+            rep = ctx.replicated()
+
+            def put(k, v):
+                if isinstance(v, dict):
+                    # int8_wo bank: quantized planes carry no logical
+                    # axes — replicate (memory cost only)
+                    return jax.device_put(v, rep)
+                return jax.device_put(
+                    v, ctx.sharding(annot.get(k),
+                                    getattr(v, 'shape', None), label=k))
+            self._params = {k: put(k, v) for k, v in self._params.items()}
+            self._buffers = {k: jax.device_put(v, rep)
+                             for k, v in self._buffers.items()}
 
         self.max_batch_size = int(max_batch_size if max_batch_size is not None
                                   else _env_int(ENV_MAX_BATCH, 16))
@@ -194,6 +221,14 @@ class InferenceEngine:
         self._cache = BucketCompileCache(self._build)
         self._trace_count = 0        # trace-time side effect: retraces show
         self._stats = ServingStats(clock=self._clock)
+        if self._mesh_ctx is not None and _obs.enabled():
+            # the mesh degree rides a dedicated gauge — the engine's own
+            # label set stays {'engine': ...} so every fleet/host/SLO
+            # exact-match lookup treats mp=N exactly like mp=1
+            _obs.registry().gauge(
+                'serve.mesh_devices',
+                {**self._stats.labels, 'mesh': f'mp{self._mesh_ctx.mp}'}
+            ).set(self._mesh_ctx.size)
         self._queues = PendingQueues()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -546,6 +581,8 @@ class InferenceEngine:
         out['precision'] = self._precision
         out['circuit_state'] = self._breaker.state
         out['warmed'] = self._warmed
+        out['mesh'] = (self._mesh_ctx.describe()
+                       if self._mesh_ctx is not None else None)
         return out
 
 

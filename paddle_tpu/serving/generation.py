@@ -51,6 +51,7 @@ Env knobs: ``PADDLE_TPU_GEN_SLOTS`` (default 8),
 ``PADDLE_TPU_GEN_PAGE_SIZE`` (default 128, clamped to max_seq_len),
 ``PADDLE_TPU_GEN_PREFIX`` (=1 enables the prefix cache by default).
 """
+import functools as _functools
 import itertools
 import os
 import sys
@@ -272,7 +273,7 @@ class GenerationEngine:
                  default_deadline_ms=None, breaker=None, autostart=True,
                  forward_fn=None, clock=None, precision=None,
                  telemetry_port=None, prefix_cache=None,
-                 prefix_cache_pages=None):
+                 prefix_cache_pages=None, mesh=None, mp=None):
         if os.environ.get('PADDLE_TPU_COMPILE_CACHE'):
             from .. import warmup as _warmup_mod
             _warmup_mod.ensure_persistent_cache()
@@ -292,6 +293,22 @@ class GenerationEngine:
                 else:
                     _fam = _gpt
                 params = _fam.quantize_decode_params(params)
+        # mesh-sharded replica (mp=N): ONE SPMD program over N chips.
+        # Params are placed by the logical-axis rules table, the forward
+        # pins the KV pool to the kv_heads layout, and everything else —
+        # scheduler, allocator, page tables, trace count — is the mp=1
+        # code verbatim (parallel/mesh_engine.py).
+        from ..parallel import mesh_engine as _mesh
+        self._mesh_ctx = _mesh.resolve(mesh, mp=mp)
+        if self._mesh_ctx is not None:
+            if precision == 'int8_wo':
+                raise ValueError(
+                    'mesh-sharded engines do not support precision='
+                    "'int8_wo' yet: the quantized bank pytree has no "
+                    'logical-axis annotations to place')
+            params = self._mesh_ctx.place_params(params, cfg)
+            fwd = _functools.partial(
+                fwd, partitioner=self._mesh_ctx.partitioner)
         self._params = params
         self.config = cfg
         self._forward_fn = fwd
@@ -327,7 +344,7 @@ class GenerationEngine:
         self._clock = clock or time.monotonic
         self._autostart = autostart
 
-        self._pool = _gpt.init_paged_kv_cache(cfg, self.num_pages, ps)
+        self._pool = self._init_pool()
         self._alloc = _pkv.PageAllocator(self.num_pages)
         # prefix cache: opt-in (constructor flag, giving it a residency
         # bound, or the env knob) — page accounting changes when finished
@@ -368,6 +385,15 @@ class GenerationEngine:
         self.telemetry = (_obs.serve_telemetry(port=telemetry_port)
                           if telemetry_port is not None else _obs.NULL_SERVER)
 
+    def _init_pool(self):
+        """Fresh paged-KV pool, head-sharded over the mesh when one is
+        active (the allocator and page tables stay host-side either way)."""
+        pool = _gpt.init_paged_kv_cache(self.config, self.num_pages,
+                                        self.page_size)
+        if self._mesh_ctx is not None:
+            pool = self._mesh_ctx.place_pool(pool)
+        return pool
+
     def _readiness_probe(self):
         with self._lock:
             depth = len(self._queue)
@@ -383,8 +409,18 @@ class GenerationEngine:
 
     # ---- telemetry -------------------------------------------------------
     def _make_metrics(self):
-        labels = {'engine': f'g{next(GenerationEngine._seq)}'}
-        self.labels = labels
+        # UNIFORMITY: the label set is identical at every mesh degree —
+        # fleet/host/SLO lookups key on exactly {'engine': ...}, and the
+        # registry matches label sets exactly, so adding a mesh label here
+        # would silently detach every control-plane rule from an mp>1
+        # replica. The mesh degree is published as its own gauge series
+        # (gen.mesh_devices, labelled engine+mesh) for /metrics slicing.
+        labels = self.labels = {'engine': f'g{next(GenerationEngine._seq)}'}
+        if self._mesh_ctx is not None and _obs.enabled():
+            _obs.registry().gauge(
+                'gen.mesh_devices',
+                {**self.labels, 'mesh': f'mp{self._mesh_ctx.mp}'}
+            ).set(self._mesh_ctx.size)
         if _obs.enabled():
             reg = _obs.registry()
             mk_c = lambda n: reg.counter(n, labels)             # noqa: E731
@@ -1034,8 +1070,7 @@ class GenerationEngine:
             if self._prefix is not None:
                 # cached KV lives in the pool being rebuilt: drop it all
                 self._prefix.clear()
-            self._pool = _gpt.init_paged_kv_cache(
-                self.config, self.num_pages, self.page_size)
+            self._pool = self._init_pool()
             self._update_gauges_locked()
             self._cv.notify_all()
         for r in failed:
@@ -1107,4 +1142,6 @@ class GenerationEngine:
         })
         out['prefix'] = (self._prefix.stats()
                          if self._prefix is not None else None)
+        out['mesh'] = (self._mesh_ctx.describe()
+                       if self._mesh_ctx is not None else None)
         return out

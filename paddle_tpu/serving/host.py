@@ -313,30 +313,47 @@ class ModelHost:
 
     # ---- footprint measurement -------------------------------------------
     def _measure_footprint(self, m, engine):
-        """The model's HBM footprint in bytes. Preference: measured
-        ``perf.hbm_bytes`` from the engine's compiled executables
+        """The model's PER-CHIP HBM footprint in bytes. Preference:
+        measured ``perf.hbm_bytes`` from the engine's compiled executables
         (argument+temp+output+code, max over executables — weights appear
         in every executable's arguments, so max approximates residency);
-        fallback: parameter/buffer/KV-pool array bytes."""
+        fallback: parameter/buffer/KV-pool array bytes.
+
+        A mesh-sharded engine's cost analysis reports MESH-GLOBAL bytes
+        (the SPMD program's whole-array arguments/temps/outputs), but the
+        watermark is a per-chip budget: argument/temp/output divide by the
+        mesh size ('code' does not — every chip holds the full program),
+        so an mp=4 deploy of a 4x model does not spuriously trip
+        :class:`HBMAdmissionError`. The division is the sharded-residency
+        upper bound: replicated fall-through leaves make a chip hold MORE
+        than total/N, which the max-over-executables argument bytes still
+        dominate in practice."""
+        from ..parallel.mesh_engine import mesh_size
+        n_chips = max(1, mesh_size(engine))
         best = 0
         aot = getattr(engine, '_aot', None) or {}
         for kind, compiled in aot.items():
             rec = _obs.perf.analyze_compiled(
                 f'host.{self.name}.{m.name}.{kind}', compiled)
             if rec:
-                total = sum(int(rec['hbm'].get(k, 0) or 0)
-                            for k in _FOOTPRINT_KINDS)
+                total = sum(
+                    int(rec['hbm'].get(k, 0) or 0) // (
+                        n_chips if k != 'code' else 1)
+                    for k in _FOOTPRINT_KINDS)
                 best = max(best, total)
         if best > 0:
             return best
+        # array-bytes fallback: params/pool are the dominant terms and
+        # both shard ~1/N over the mesh
         est = _tree_nbytes(getattr(engine, '_params', None))
         est += _tree_nbytes(getattr(engine, '_buffers', None))
         est += _tree_nbytes(getattr(engine, '_pool', None))
-        return est
+        return est // n_chips
 
     # ---- admission / deploy ----------------------------------------------
     def deploy(self, name, factory, *, footprint_bytes=None, input_spec=None,
-               pin=False, warm=True, breaker=None, prefix_cache_pages=None):
+               pin=False, warm=True, breaker=None, prefix_cache_pages=None,
+               mp=None):
         """Admit one model onto the host.
 
         ``factory`` is a zero-arg callable building the model's engine —
@@ -348,7 +365,18 @@ class ModelHost:
         engine's prefix-cache residency (applied after every build, so the
         bound survives evict/swap-in cycles). Raises
         :class:`HBMAdmissionError` when the model cannot fit even after
-        evicting every cold model."""
+        evicting every cold model.
+
+        ``mp=N`` deploys a mesh-sharded replica: the factory is called as
+        ``factory(mp=N)`` on every (re)build, so swap-in after an eviction
+        reconstructs the same mesh shape. Admission then accounts the
+        measured footprint PER CHIP against the per-chip watermark (see
+        ``_measure_footprint``); warmth snapshots restore across swap-ins
+        exactly like mp=1 — the executables hold no weights, only the
+        placements."""
+        if mp is not None:
+            base_factory, mp = factory, int(mp)
+            factory = lambda: base_factory(mp=mp)       # noqa: E731
         try:
             fault.inject('host.admit')
         except InjectedFault:

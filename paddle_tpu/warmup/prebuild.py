@@ -48,9 +48,13 @@ def _struct(shape, dtype):
 
 
 def _tree_structs(tree):
-    """Abstract (shape, dtype) skeleton of a pytree of arrays."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), tree)
+    """Abstract (shape, dtype) skeleton of a pytree of arrays. Leaves
+    committed to a multi-device mesh keep their NamedSharding (via
+    parallel.mesh_engine.sharded_structs): an executable AOT-compiled for
+    a mesh-sharded engine must expect exactly the placements the live
+    path passes, or the first real call would recompile."""
+    from ..parallel.mesh_engine import sharded_structs
+    return sharded_structs(tree)
 
 
 def _key_struct():

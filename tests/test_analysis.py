@@ -161,10 +161,16 @@ def test_cli_baseline_workflow(tmp_path):
 
 
 def test_repo_self_lint_gate():
-    """THE CI GATE: the full suite over paddle_tpu/ must be clean — fix
-    the finding, acknowledge it with a pragma, or baseline it with a
-    reason. New hazards fail this tier-1 test."""
-    r = _lint(os.path.join(REPO, 'paddle_tpu'), '--json')
+    """THE CI GATE: the full suite over paddle_tpu/ (plus the mesh/
+    sharding drill tools, which carry real trace-hygiene and sharding
+    logic) must be clean — fix the finding, acknowledge it with a
+    pragma, or baseline it with a reason. New hazards fail this tier-1
+    test."""
+    r = _lint(os.path.join(REPO, 'paddle_tpu'),
+              os.path.join(REPO, 'tools', 'mesh_drill.py'),
+              os.path.join(REPO, 'tools', 'shard_check.py'),
+              os.path.join(REPO, 'tools', 'fleet_drill.py'),
+              '--json')
     assert r.returncode == 0, f'lint gate failed:\n{r.stdout}\n{r.stderr}'
     payload = json.loads(r.stdout)
     assert payload['ok'] is True

@@ -3,7 +3,11 @@
 anywhere (CPU-safe, fresh subprocess).
 
 One child process builds a two-replica generation fleet behind a
-``FleetRouter`` and drives three phases:
+``FleetRouter`` — one replica single-chip, one MESH-SHARDED over an mp=2
+device mesh (the uniformity proof: the router, the failover mirror and
+the autoscaler cannot tell them apart, and failover between mesh shapes
+stays byte-identical because sampling keys depend only on
+(seed, position)) — and drives three phases:
 
   1. **healthy wave** — N streams against the warm fleet; per-request
      end-to-end latencies give ``healthy_p99_ms``;
@@ -60,7 +64,8 @@ def _child(n_requests, n_tokens):
     from paddle_tpu import observability as obs
     from paddle_tpu.models import gpt
     from paddle_tpu.serving import (Autoscaler, FleetRouter,
-                                    GenerationEngine, ReplicaSet)
+                                    GenerationEngine, ReplicaSet,
+                                    sharded_generation_engine)
 
     cfg = gpt.GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
                         num_heads=2, max_seq_len=32, dtype='float32',
@@ -70,11 +75,13 @@ def _child(n_requests, n_tokens):
     prompts = [rng.integers(1, cfg.vocab_size, size=4 + i % 5)
                for i in range(n_requests)]
 
-    def engine(**kw):
+    def engine(mp=1, **kw):
         kw.setdefault('num_slots', 2)
         kw.setdefault('page_size', 8)
         kw.setdefault('prefill_width', 16)
         kw.setdefault('queue_capacity', 64)
+        if mp > 1:
+            return sharded_generation_engine(params, cfg, mp=mp, **kw)
         return GenerationEngine(params, cfg, **kw)
 
     # single-engine reference: the byte-identity baseline
@@ -103,8 +110,11 @@ def _child(n_requests, n_tokens):
             lats.append((time.perf_counter() - t0[i]) * 1e3)
         return streams, lats
 
-    # phase 1+2 fleet: two directly-warmed replicas
-    engines = [engine(), engine()]
+    # phase 1+2 fleet: two directly-warmed replicas — one single-chip,
+    # one mesh-sharded over mp=2 (vocab 97 does not divide 2, so its
+    # embedding rides the fallback-to-replicated path on purpose)
+    engines = [engine(), engine(mp=2)]
+    out['sharded_replica_mp'] = 2
     for e in engines:
         e.submit(np.array([3, 1, 4]), max_new_tokens=2,
                  seed=999).result(timeout=300)
@@ -153,8 +163,10 @@ def _child(n_requests, n_tokens):
     router.close(drain=False)
 
     # phase 3: autoscale-up from the warm template under a queue-wait
-    # SLO breach; the spawned replica must serve with zero retraces
-    rset2 = ReplicaSet(lambda: engine(num_slots=1), initial=1,
+    # SLO breach; the spawned replica must serve with zero retraces.
+    # The template is itself mesh-sharded: warm spawn clones the AOT
+    # executables, whose input shardings carry the mesh placements.
+    rset2 = ReplicaSet(lambda: engine(mp=2, num_slots=1), initial=1,
                        min_replicas=1, max_replicas=2)
     asc = Autoscaler(qwait_p99_ms=1.0, idle_s=30.0, cooldown_s=0.2,
                      debounce=1)
@@ -182,10 +194,15 @@ def _child(n_requests, n_tokens):
 def run_drill(n_requests=8, n_tokens=24, timeout=900):
     """Run the drill in a fresh subprocess; returns the summary dict with
     the aggregate ``ok`` verdict (importable from bench.py and tests)."""
+    env = dict(os.environ)
+    # the sharded replica needs >= 2 devices: force the CPU emulation in
+    # the child (never in this process — jax may already be initialized)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+    env['JAX_PLATFORMS'] = 'cpu'
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), '--child',
          '--requests', str(n_requests), '--tokens', str(n_tokens)],
-        capture_output=True, text=True, timeout=timeout)
+        capture_output=True, text=True, timeout=timeout, env=env)
     if proc.returncode != 0:
         raise RuntimeError(f'fleet drill child failed:\n{proc.stdout}\n'
                            f'{proc.stderr}')
@@ -206,6 +223,8 @@ def main(argv=None):
     ap.add_argument('--child', action='store_true', help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.child:
+        os.environ.setdefault('XLA_FLAGS',
+                              '--xla_force_host_platform_device_count=2')
         _child(args.requests, args.tokens)
         return 0
     result = run_drill(n_requests=args.requests, n_tokens=args.tokens)
